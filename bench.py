@@ -1,0 +1,81 @@
+"""Benchmark: weakly-supervised training throughput, pairs/sec on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference repo publishes no throughput numbers (BASELINE.md).
+``V100_EST_PAIRS_PER_SEC`` is an analytic estimate for the reference
+implementation on a single V100 at the PF-Pascal training config (batch 16,
+400x400, NC 5-5-5/16-16-1): ~2 TFLOP/pair with the Python-loop conv4d
+(25 iterations x 11 cuDNN conv3d calls per Conv4d, launch-latency bound,
+lib/conv4d.py:39-48) on a 15.7 TFLOPs fp32 part => ~4 pairs/sec.
+``vs_baseline`` = measured pairs/sec/chip divided by that estimate.
+"""
+
+import json
+import time
+
+import numpy as np
+
+V100_EST_PAIRS_PER_SEC = 4.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+    from ncnet_tpu.train.step import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    batch_size = 16
+    config = ImMatchNetConfig(
+        ncons_kernel_sizes=(5, 5, 5),
+        ncons_channels=(16, 16, 1),
+        half_precision=True,  # bf16 correlation/NC path (TPU-native)
+        conv4d_impl="scan",  # memory-bounded conv4d for the backward pass
+        nc_remat=True,
+    )
+    params = init_immatchnet(jax.random.PRNGKey(0), config)
+    optimizer = make_optimizer()
+    state = create_train_state(params, optimizer)
+    step = make_train_step(config, optimizer)
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "source_image": jnp.asarray(
+            rng.randn(batch_size, 400, 400, 3).astype(np.float32)
+        ),
+        "target_image": jnp.asarray(
+            rng.randn(batch_size, 400, 400, 3).astype(np.float32)
+        ),
+    }
+
+    # compile + warmup
+    state, loss = step(state, batch)
+    jax.block_until_ready(loss)
+
+    n_steps = 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, loss = step(state, batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    pairs_per_sec = batch_size * n_steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": "train_pairs_per_sec_per_chip_400px_resnet101",
+                "value": round(pairs_per_sec, 3),
+                "unit": "pairs/s",
+                "vs_baseline": round(pairs_per_sec / V100_EST_PAIRS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""ncnet_tpu — a TPU-native dense-correspondence framework.
+
+A ground-up JAX/XLA/Pallas/pjit reimplementation of the capabilities of
+Neighbourhood Consensus Networks (Rocco et al., NeurIPS 2018; reference
+implementation GrumpyZhou/ncnet): dense CNN feature extraction, the all-pairs
+4D correlation tensor, soft mutual-nearest-neighbour filtering, learned 4D
+neighbourhood-consensus convolutions, weakly-supervised training, and the
+PF-Pascal / InLoc evaluation harnesses.
+
+Design notes (TPU-first, not a port):
+  * channels-last (NHWC) feature layouts; correlation tensors are
+    ``[batch, iA, jA, iB, jB]`` with an explicit trailing channel axis only
+    inside the neighbourhood-consensus stack;
+  * the 4D convolution compiles as a single XLA convolution with four spatial
+    dimensions (MXU), with a tap-decomposition fallback and a Pallas kernel;
+  * relocalization fuses correlation and 4D max-pooling so the high-resolution
+    correlation tensor is never materialized in HBM;
+  * scaling is expressed with `jax.sharding.Mesh` + `shard_map`: batch data
+    parallelism with `psum` gradient reduction, and spatial sharding of the
+    correlation tensor (the long-context analog) with halo exchange.
+"""
+
+from ncnet_tpu import data, models, ops, parallel, train, utils
+from ncnet_tpu.models.immatchnet import ImMatchNet, ImMatchNetConfig
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ImMatchNet",
+    "ImMatchNetConfig",
+    "data",
+    "models",
+    "ops",
+    "parallel",
+    "train",
+    "utils",
+]

@@ -1,0 +1,1 @@
+"""Host-side input pipeline: pair datasets, image IO, prefetching."""

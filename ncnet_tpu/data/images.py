@@ -1,0 +1,76 @@
+"""Host-side image decode / resize / normalize.
+
+All host-side preprocessing is numpy (the device never sees raw images):
+decode with PIL, bilinear align-corners resize (parity with the reference's
+identity-affine grid_sample resize, lib/transformation.py:41-63), ImageNet
+normalization. A C++ fast path for resize+normalize is loaded via ctypes
+when built (`ncnet_tpu.data.native`).
+"""
+
+import numpy as np
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def load_image(path):
+    """Decode an image file -> float32 RGB [h, w, 3] in 0..255.
+
+    Grayscale images are stacked to 3 channels (reference
+    lib/im_pair_dataset.py:64-65).
+    """
+    from PIL import Image
+
+    with Image.open(path) as im:
+        arr = np.asarray(im)
+    if arr.ndim == 2:
+        arr = np.stack([arr] * 3, axis=-1)
+    if arr.shape[-1] == 4:
+        arr = arr[..., :3]
+    return arr.astype(np.float32)
+
+
+def resize_bilinear_np(image, out_h, out_w):
+    """Align-corners bilinear resize, numpy, channels-last [h, w, c]."""
+    try:
+        from ncnet_tpu.data.native import resize_bilinear_native
+
+        out = resize_bilinear_native(image, out_h, out_w)
+        if out is not None:
+            return out
+    except ImportError:
+        pass
+    h, w = image.shape[:2]
+    if (h, w) == (out_h, out_w):
+        return image.astype(np.float32)
+
+    def axis_coords(n_in, n_out):
+        if n_out == 1:
+            return np.zeros(1), np.zeros(1, np.int64), np.zeros(1, np.int64)
+        pos = np.linspace(0.0, n_in - 1.0, n_out)
+        lo = np.floor(pos).astype(np.int64)
+        hi = np.minimum(lo + 1, n_in - 1)
+        return pos - lo, lo, hi
+
+    fy, y0, y1 = axis_coords(h, out_h)
+    fx, x0, x1 = axis_coords(w, out_w)
+    img = image.astype(np.float32)
+    top = img[y0] * (1 - fy)[:, None, None] + img[y1] * fy[:, None, None]
+    out = (
+        top[:, x0] * (1 - fx)[None, :, None]
+        + top[:, x1] * fx[None, :, None]
+    )
+    return out
+
+
+def normalize_image_np(image):
+    """0..255 float RGB -> ImageNet-normalized (in place when possible)."""
+    return (image / 255.0 - IMAGENET_MEAN) / IMAGENET_STD
+
+
+def preprocess(path, out_h, out_w):
+    """decode -> resize -> normalize. Returns ([h,w,3] float32, orig (h,w))."""
+    img = load_image(path)
+    orig = img.shape[:2]
+    img = resize_bilinear_np(img, out_h, out_w)
+    return normalize_image_np(img), orig

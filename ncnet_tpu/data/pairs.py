@@ -1,0 +1,198 @@
+"""CSV-driven image-pair datasets (reference schemas, SURVEY.md §2.5).
+
+* Training pairs (`ImagePairDataset`, lib/im_pair_dataset.py:11-93):
+  ``source_image,target_image,class,flip`` — weak supervision, optional
+  horizontal flip per row, resize to a square training size.
+* PF-Pascal eval pairs (`PFPascalDataset`, lib/pf_dataset.py:11-112):
+  adds semicolon-separated keypoint columns ``XA;YA;XB;YB`` (up to 20
+  points, -1-padded) and the PCK reference length per the 'pf' (max GT
+  bbox side) or 'scnet' (rescale to 224) procedure.
+
+Datasets are plain indexable objects returning numpy dicts; batching /
+prefetching lives in `ncnet_tpu.data.loader`.
+"""
+
+import os
+
+import numpy as np
+
+from ncnet_tpu.data.images import load_image, normalize_image_np, resize_bilinear_np
+
+PF_PASCAL_CATEGORIES = (
+    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
+    "chair", "cow", "diningtable", "dog", "horse", "motorbike", "person",
+    "pottedplant", "sheep", "sofa", "train", "tvmonitor",
+)
+
+MAX_KEYPOINTS = 20
+
+
+def _read_csv(path):
+    import csv
+
+    with open(path, newline="") as f:
+        rows = list(csv.reader(f))
+    return rows[0], rows[1:]
+
+
+class ImagePairDataset:
+    """Weak-supervision training pairs."""
+
+    def __init__(
+        self,
+        csv_file,
+        dataset_path,
+        output_size=(400, 400),
+        random_crop=False,
+        normalize=True,
+        seed=0,
+    ):
+        self.header, self.rows = _read_csv(csv_file)
+        self.dataset_path = dataset_path
+        self.out_h, self.out_w = output_size
+        self.random_crop = random_crop
+        self.normalize = normalize
+        self.seed = seed
+
+    def __len__(self):
+        return len(self.rows)
+
+    def _load(self, name, flip, crop_rng):
+        img = load_image(os.path.join(self.dataset_path, name))
+        if crop_rng is not None:
+            # reference crop (lib/im_pair_dataset.py:68-74): corners anchored
+            # in the outer quarters, so the window is always >= half size
+            h, w = img.shape[:2]
+            top = crop_rng.randint(max(h // 4, 1))
+            bottom = int(3 * h / 4 + crop_rng.randint(max(h // 4, 1)))
+            left = crop_rng.randint(max(w // 4, 1))
+            right = int(3 * w / 4 + crop_rng.randint(max(w // 4, 1)))
+            img = img[top:bottom, left:right]
+        if flip:
+            img = img[:, ::-1]
+        img = resize_bilinear_np(img, self.out_h, self.out_w)
+        if self.normalize:
+            img = normalize_image_np(img)
+        return img
+
+    def __getitem__(self, idx):
+        row = self.rows[idx]
+        name_a, name_b = row[0], row[1]
+        flip = bool(int(float(row[3]))) if len(row) > 3 else False
+        # per-sample RNG derived from (seed, idx): thread-safe and identical
+        # for any worker count (the invariant data/loader.py relies on)
+        crop_rng = (
+            np.random.RandomState((self.seed * 100003 + idx) % (2**31))
+            if self.random_crop
+            else None
+        )
+        return {
+            "source_image": self._load(name_a, flip, crop_rng),
+            "target_image": self._load(name_b, flip, crop_rng),
+            "set_class": np.float32(float(row[2])) if len(row) > 2 else np.float32(0),
+        }
+
+
+class PFPascalDataset:
+    """PF-Pascal keypoint-annotated eval pairs."""
+
+    def __init__(
+        self,
+        csv_file,
+        dataset_path,
+        output_size=(400, 400),
+        category=None,
+        pck_procedure="scnet",
+        normalize=True,
+    ):
+        self.header, rows = _read_csv(csv_file)
+        if category is not None:
+            rows = [r for r in rows if int(float(r[2])) == int(category)]
+        self.rows = rows
+        self.dataset_path = dataset_path
+        self.out_h, self.out_w = output_size
+        self.pck_procedure = pck_procedure
+        self.normalize = normalize
+
+    def __len__(self):
+        return len(self.rows)
+
+    @staticmethod
+    def _points(xs, ys):
+        x = np.fromstring(xs, sep=";")
+        y = np.fromstring(ys, sep=";")
+        pts = -np.ones((2, MAX_KEYPOINTS), np.float32)
+        pts[0, : len(x)] = x
+        pts[1, : len(y)] = y
+        return pts
+
+    def __getitem__(self, idx):
+        row = self.rows[idx]
+        img_a = load_image(os.path.join(self.dataset_path, row[0]))
+        img_b = load_image(os.path.join(self.dataset_path, row[1]))
+        size_a = np.asarray(img_a.shape, np.float32)
+        size_b = np.asarray(img_b.shape, np.float32)
+        pts_a = self._points(row[3], row[4])
+        pts_b = self._points(row[5], row[6])
+        n_pts = int(np.sum(pts_a[0] != -1))
+
+        if self.pck_procedure == "pf":
+            l_pck = np.float32(
+                np.max(
+                    pts_a[:, :n_pts].max(axis=1) - pts_a[:, :n_pts].min(axis=1)
+                )
+            )
+        elif self.pck_procedure == "scnet":
+            # SCNet protocol (lib/pf_dataset.py:66-75): rescale points as if
+            # images were 224x224; L_pck = 224.
+            pts_a[0, :n_pts] *= 224 / size_a[1]
+            pts_a[1, :n_pts] *= 224 / size_a[0]
+            pts_b[0, :n_pts] *= 224 / size_b[1]
+            pts_b[1, :n_pts] *= 224 / size_b[0]
+            size_a[0:2] = 224
+            size_b[0:2] = 224
+            l_pck = np.float32(224.0)
+        else:
+            raise ValueError(f"unknown pck procedure {self.pck_procedure!r}")
+
+        def prep(img):
+            img = resize_bilinear_np(img, self.out_h, self.out_w)
+            return normalize_image_np(img) if self.normalize else img
+
+        return {
+            "source_image": prep(img_a),
+            "target_image": prep(img_b),
+            "source_im_size": size_a[:3],
+            "target_im_size": size_b[:3],
+            "source_points": pts_a,
+            "target_points": pts_b,
+            "L_pck": np.asarray([l_pck], np.float32),
+        }
+
+
+class SyntheticPairDataset:
+    """Synthetic stand-in when no image data is on disk (CI, benchmarks).
+
+    Target = source warped by a random horizontal roll, so trained models
+    have real (cyclic-translation) structure to learn.
+    """
+
+    def __init__(self, n=256, output_size=(400, 400), seed=0):
+        self.n = n
+        self.out_h, self.out_w = output_size
+        self.seed = seed
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed * 100003 + idx)
+        base = rng.rand(self.out_h // 8, self.out_w // 8, 3).astype(np.float32)
+        img = resize_bilinear_np(base * 255.0, self.out_h, self.out_w)
+        shift = rng.randint(0, self.out_w // 2)
+        tgt = np.roll(img, shift, axis=1)
+        return {
+            "source_image": normalize_image_np(img),
+            "target_image": normalize_image_np(tgt),
+            "set_class": np.float32(0),
+        }

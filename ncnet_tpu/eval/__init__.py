@@ -1,0 +1,5 @@
+"""Evaluation harnesses: PF-Pascal PCK and the InLoc match dump."""
+
+from ncnet_tpu.eval import pf_pascal
+
+__all__ = ["pf_pascal"]

@@ -1,0 +1,186 @@
+"""InLoc dense-match dump.
+
+Reproduces the Python-side contract of the reference's eval_inloc.py so the
+downstream MATLAB PnP-RANSAC + pose-verification pipeline runs unmodified:
+one ``matches/<experiment>/<q+1>.mat`` per query containing a ``matches``
+array ``[1, Npanos, N, 5]`` of ``(xA, yA, xB, yB, score)`` rows in
+normalized [0, 1] coordinates (eval_inloc.py:126,199-203,221).
+
+Pipeline per (query, pano) pair (eval_inloc.py:124-203):
+  aspect-preserving resize with the feature grid quantized to multiples of
+  ``k_size`` (so 4D max-pool relocalization divides evenly)
+  -> bf16 forward with fused correlation+maxpool4d
+  -> `corr_to_matches` in both directions (scale='positive', softmax)
+  -> concatenate, sort by descending score, coordinate-level dedup
+  -> recenter normalized coords to feature-cell centers.
+
+XLA note: every distinct image shape compiles once; the k_size·stride
+quantization already buckets shapes to a small set, so the jit cache acts
+as the shape-bucketing layer.
+"""
+
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.data.images import load_image, normalize_image_np, resize_bilinear_np
+from ncnet_tpu.models.feature_extraction import backbone_stride
+from ncnet_tpu.models.immatchnet import immatchnet_apply
+from ncnet_tpu.ops.matches import corr_to_matches
+
+SCALE_FACTOR = 0.0625  # 1/backbone stride (reference eval_inloc.py:77)
+
+
+def _to_str(x):
+    """Unwrap scipy-loaded MATLAB cell/char nesting to a plain str."""
+    while isinstance(x, np.ndarray):
+        x = x.ravel()[0]
+    return str(x)
+
+
+def quantized_resize_shape(h, w, image_size, k_size):
+    """The reference's resize rule (eval_inloc.py:84-89): max side ->
+    ``image_size``, then quantize so feature-grid dims divide by k_size."""
+    ratio = max(h, w) / image_size
+    if k_size == 1:
+        return int(h / ratio), int(w / ratio)
+    s = SCALE_FACTOR
+    return (
+        int(np.floor(h / ratio * s / k_size) / s * k_size),
+        int(np.floor(w / ratio * s / k_size) / s * k_size),
+    )
+
+
+def load_and_preprocess(path, image_size, k_size):
+    img = load_image(path)
+    h, w = quantized_resize_shape(img.shape[0], img.shape[1], image_size, k_size)
+    img = resize_bilinear_np(img, h, w)
+    return normalize_image_np(img)[None]  # [1, h, w, 3]
+
+
+def make_match_fn(config):
+    """(params, src, tgt) -> (fwd, rev) match tuples for one pair (jittable)."""
+    k = config.relocalization_k_size
+
+    def fn(params, src, tgt):
+        out = immatchnet_apply(params, config, src, tgt)
+        corr, delta4d = out if k > 1 else (out, None)
+        kw = dict(scale="positive", do_softmax=True, delta4d=delta4d, k_size=max(k, 1))
+        fwd = corr_to_matches(corr, **kw)
+        rev = corr_to_matches(corr, invert_matching_direction=True, **kw)
+        return fwd, rev
+
+    return fn
+
+
+def recenter(coord, n_cells):
+    """Normalized [0,1] grid coords -> feature-cell centers
+    (eval_inloc.py:179-189)."""
+    return coord * (n_cells - 1) / n_cells + 0.5 / n_cells
+
+
+def match_pair(match_fn, params, src, tgt, k_size, stride=16,
+               both_directions=True, flip_direction=False, dedup=True):
+    """Returns (xA, yA, xB, yB, score) numpy arrays for one image pair."""
+    fwd, rev = match_fn(params, src, tgt)
+    k = max(k_size, 1)
+    # pooled correlation grid dims, derived from the image shapes
+    fs1 = src.shape[1] // stride // k
+    fs2 = src.shape[2] // stride // k
+    fs3 = tgt.shape[1] // stride // k
+    fs4 = tgt.shape[2] // stride // k
+    if both_directions:
+        parts = [np.asarray(jnp.concatenate([a, b], axis=1)) for a, b in zip(fwd, rev)]
+    elif flip_direction:
+        parts = [np.asarray(v) for v in rev]
+    else:
+        parts = [np.asarray(v) for v in fwd]
+    xa, ya, xb, yb, score = [p[0] for p in parts]
+
+    if both_directions:
+        order = np.argsort(-score)  # descending; keeps max-score dup first
+        xa, ya, xb, yb, score = (v[order] for v in (xa, ya, xb, yb, score))
+        if dedup:
+            coords = np.stack([xa, ya, xb, yb])
+            _, uniq = np.unique(coords, axis=1, return_index=True)
+            xa, ya, xb, yb, score = (v[uniq] for v in (xa, ya, xb, yb, score))
+
+    ya = recenter(ya, fs1 * k)
+    xa = recenter(xa, fs2 * k)
+    yb = recenter(yb, fs3 * k)
+    xb = recenter(xb, fs4 * k)
+    return xa, ya, xb, yb, score
+
+
+def n_match_slots(image_size, k_size, both_directions):
+    """Fixed slot count of the .mat contract (eval_inloc.py:116-118)."""
+    g = image_size * SCALE_FACTOR / k_size
+    n = int(g * np.floor(g * (3 / 4)))
+    return 2 * n if both_directions else n
+
+
+def dump_matches(
+    params,
+    config,
+    shortlist_path,
+    query_path,
+    pano_path,
+    output_dir,
+    image_size=3200,
+    n_queries=356,
+    n_panos=10,
+    both_directions=True,
+    flip_direction=False,
+    verbose=True,
+):
+    """Run the full dump. Writes ``<output_dir>/<q+1>.mat`` per query."""
+    from scipy.io import loadmat, savemat
+
+    k_size = config.relocalization_k_size
+    assert backbone_stride(config.feature_extraction_cnn) == int(1 / SCALE_FACTOR)
+
+    dbmat = loadmat(shortlist_path)
+    db = dbmat["ImgList"][0, :]
+    pano_fn_all = np.vstack(tuple(db[q][1] for q in range(len(db))))
+
+    os.makedirs(output_dir, exist_ok=True)
+    jitted = jax.jit(make_match_fn(config))
+    stride = backbone_stride(config.feature_extraction_cnn)
+
+    n_slots = n_match_slots(image_size, k_size, both_directions)
+    for q in range(n_queries):
+        out_path = os.path.join(output_dir, f"{q + 1}.mat")
+        if os.path.exists(out_path):  # resumable, unlike the reference
+            continue
+        matches = np.zeros((1, n_panos, n_slots, 5))
+        query_fn = _to_str(db[q][0])
+        src = jnp.asarray(
+            load_and_preprocess(os.path.join(query_path, query_fn), image_size, k_size)
+        )
+        for idx in range(n_panos):
+            pano_fn = _to_str(db[q][1].ravel()[idx])
+            tgt = jnp.asarray(
+                load_and_preprocess(
+                    os.path.join(pano_path, pano_fn), image_size, k_size
+                )
+            )
+            xa, ya, xb, yb, score = match_pair(
+                jitted, params, src, tgt, k_size, stride,
+                both_directions, flip_direction,
+            )
+            n = min(len(xa), n_slots)
+            matches[0, idx, :n, 0] = xa[:n]
+            matches[0, idx, :n, 1] = ya[:n]
+            matches[0, idx, :n, 2] = xb[:n]
+            matches[0, idx, :n, 3] = yb[:n]
+            matches[0, idx, :n, 4] = score[:n]
+        savemat(
+            out_path,
+            {"matches": matches, "query_fn": query_fn, "pano_fn": pano_fn_all},
+            do_compression=True,
+        )
+        if verbose:
+            print(f"query {q + 1}/{n_queries} -> {out_path}", flush=True)

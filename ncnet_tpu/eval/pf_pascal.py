@@ -1,0 +1,74 @@
+"""PF-Pascal PCK@alpha evaluation.
+
+Mirrors eval_pf_pascal.py of the reference: per pair, forward ->
+``corr_to_matches(do_softmax=True)`` -> bilinear keypoint transfer ->
+PCK against -1-padded ground-truth keypoints with the 'scnet' L_pck
+procedure (eval_pf_pascal.py:46-89). The mean is over valid (non-NaN)
+pairs.
+
+Unlike the reference (batch_size=1 only, eval_pf_pascal.py:52-53), the
+metric pipeline here is fully batched and jit-compiled end-to-end.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.models.immatchnet import immatchnet_apply
+from ncnet_tpu.ops.coords import points_to_pixel_coords, points_to_unit_coords
+from ncnet_tpu.ops.matches import bilinear_point_transfer, corr_to_matches
+from ncnet_tpu.ops.metrics import pck
+
+
+def make_pck_step(config, alpha=0.1):
+    """Returns jitted ``step(params, batch) -> [b] per-pair PCK``."""
+
+    def step(params, batch):
+        corr = immatchnet_apply(
+            params, config, batch["source_image"], batch["target_image"]
+        )
+        x_a, y_a, x_b, y_b, _ = corr_to_matches(corr, do_softmax=True)
+        tgt_norm = points_to_unit_coords(
+            batch["target_points"], batch["target_im_size"]
+        )
+        warped_norm = bilinear_point_transfer((x_a, y_a, x_b, y_b), tgt_norm)
+        warped = points_to_pixel_coords(warped_norm, batch["source_im_size"])
+        return pck(batch["source_points"], warped, batch["L_pck"], alpha=alpha)
+
+    return jax.jit(step)
+
+
+def evaluate(params, config, loader, alpha=0.1, verbose=True):
+    """Run PCK over a loader of PFPascalDataset batches.
+
+    Returns ``{'pck': mean, 'per_pair': [...], 'n_valid': int}``.
+    """
+    step = make_pck_step(config, alpha)
+    per_pair = []
+    for i, batch in enumerate(loader):
+        jbatch = {
+            k: jnp.asarray(v)
+            for k, v in batch.items()
+            if k
+            in (
+                "source_image",
+                "target_image",
+                "source_points",
+                "target_points",
+                "source_im_size",
+                "target_im_size",
+                "L_pck",
+            )
+        }
+        scores = np.asarray(step(params, jbatch))
+        per_pair.extend(scores.tolist())
+        if verbose:
+            print(f"batch [{i + 1}/{len(loader)}]", flush=True)
+    arr = np.asarray(per_pair)
+    valid = ~np.isnan(arr) & (arr != -1)
+    return {
+        "pck": float(arr[valid].mean()) if valid.any() else float("nan"),
+        "per_pair": per_pair,
+        "n_valid": int(valid.sum()),
+    }

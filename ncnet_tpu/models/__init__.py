@@ -1,0 +1,20 @@
+"""Model zoo: feature backbones, neighbourhood consensus, full matchers.
+
+All models are pure functions over explicit parameter pytrees (nested dicts
+of jnp arrays): ``init_*(rng, ...) -> params`` and ``*_apply(params, x)``.
+This keeps the frozen-backbone / trainable-head split, torch checkpoint
+conversion, and sharding annotations trivial.
+"""
+
+from ncnet_tpu.models import feature_extraction, immatchnet, neigh_consensus, resnet, vgg
+from ncnet_tpu.models.immatchnet import ImMatchNet, ImMatchNetConfig
+
+__all__ = [
+    "ImMatchNet",
+    "ImMatchNetConfig",
+    "feature_extraction",
+    "immatchnet",
+    "neigh_consensus",
+    "resnet",
+    "vgg",
+]

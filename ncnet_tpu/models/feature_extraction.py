@@ -1,0 +1,51 @@
+"""Feature-extraction front end: backbone trunk + per-location L2 norm.
+
+Reference ``FeatureExtraction`` (lib/model.py:19-87): a truncated pretrained
+backbone, frozen by default, output L2-normalized. The broken
+``resnet101fpn`` path (undefined ``fpn_body``, lib/model.py:46-67) is
+intentionally not reproduced.
+"""
+
+import jax.numpy as jnp
+
+from ncnet_tpu.models import resnet, vgg
+from ncnet_tpu.ops.norm import feature_l2norm
+
+BACKBONES = {
+    "resnet101": (resnet.init_resnet101_trunk, resnet.resnet101_trunk_apply, 16, 1024),
+    "vgg": (vgg.init_vgg16_trunk, vgg.vgg16_trunk_apply, 16, 512),
+}
+
+
+def backbone_stride(name):
+    return BACKBONES[name][2]
+
+
+def backbone_channels(name):
+    return BACKBONES[name][3]
+
+
+def init_feature_extraction(rng, cnn="resnet101"):
+    if cnn not in BACKBONES:
+        raise ValueError(f"unknown backbone {cnn!r}; have {sorted(BACKBONES)}")
+    return BACKBONES[cnn][0](rng)
+
+
+def feature_extraction_apply(params, image, cnn="resnet101", normalize=True, dtype=None):
+    """``[b, h, w, 3]`` normalized image -> L2-normalized feature map.
+
+    Args:
+      dtype: optional compute dtype override (e.g. jnp.bfloat16) applied to
+        the input and parameters — TPU-native replacement for the reference's
+        fp16 eval mode (lib/model.py:253-258).
+    """
+    apply_fn = BACKBONES[cnn][1]
+    if dtype is not None:
+        import jax
+
+        params = jax.tree.map(lambda p: p.astype(dtype), params)
+        image = image.astype(dtype)
+    feats = apply_fn(params, image)
+    if normalize:
+        feats = feature_l2norm(feats, axis=-1)
+    return feats

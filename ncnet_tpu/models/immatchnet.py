@@ -1,0 +1,171 @@
+"""ImMatchNet: the full dense-matching model.
+
+Pipeline (reference lib/model.py:261-282):
+  feature extraction (frozen trunk, L2 norm)  [x2: source, target]
+  -> all-pairs 4D correlation
+  -> [relocalization: 4D max-pool with argmax offsets — here FUSED with the
+      correlation so the high-res tensor never hits HBM]
+  -> soft mutual-NN filtering
+  -> symmetric neighbourhood-consensus 4D convolutions
+  -> soft mutual-NN filtering
+
+The config is self-describing and travels with every checkpoint, mirroring
+the reference's checkpoint-embedded args (lib/model.py:211-220): eval tools
+never need architecture flags.
+"""
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.models.feature_extraction import (
+    backbone_channels,
+    backbone_stride,
+    feature_extraction_apply,
+    init_feature_extraction,
+)
+from ncnet_tpu.models.neigh_consensus import init_neigh_consensus, neigh_consensus_apply
+from ncnet_tpu.ops.correlation import correlation_4d, correlation_maxpool4d
+from ncnet_tpu.ops.matching import mutual_matching
+
+
+@dataclasses.dataclass(frozen=True)
+class ImMatchNetConfig:
+    """Architecture + numerics config (hashable, jit-static)."""
+
+    feature_extraction_cnn: str = "resnet101"
+    ncons_kernel_sizes: Tuple[int, ...] = (3, 3, 3)
+    ncons_channels: Tuple[int, ...] = (10, 10, 1)
+    normalize_features: bool = True
+    symmetric_mode: bool = True
+    relocalization_k_size: int = 0
+    half_precision: bool = False  # bf16 feature/correlation path (TPU-native fp16)
+    conv4d_impl: str = "xla"
+    nc_remat: bool = False  # rematerialize each NC layer in the backward pass
+
+    def replace(self, **kw):
+        return dataclasses.replace(self, **kw)
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["ncons_kernel_sizes"] = list(d["ncons_kernel_sizes"])
+        d["ncons_channels"] = list(d["ncons_channels"])
+        return d
+
+    @classmethod
+    def from_dict(cls, d):
+        d = dict(d)
+        d["ncons_kernel_sizes"] = tuple(d["ncons_kernel_sizes"])
+        d["ncons_channels"] = tuple(d["ncons_channels"])
+        return cls(**d)
+
+
+def init_immatchnet(rng, config: ImMatchNetConfig):
+    """Random init. ``params['feature_extraction']`` is the frozen trunk,
+    ``params['neigh_consensus']`` the trainable head (reference freezes the
+    backbone: lib/model.py:75-78)."""
+    k_fe, k_nc = jax.random.split(rng)
+    return {
+        "feature_extraction": init_feature_extraction(
+            k_fe, config.feature_extraction_cnn
+        ),
+        "neigh_consensus": init_neigh_consensus(
+            k_nc, config.ncons_kernel_sizes, config.ncons_channels
+        ),
+    }
+
+
+def match_pipeline(nc_params, config: ImMatchNetConfig, feat_a, feat_b):
+    """Features -> filtered correlation: corr -> [pooled] -> MM -> NC -> MM.
+
+    Split out from the full forward so the training loss can reuse extracted
+    features for the rolled-negative pair (the reference recomputes the
+    backbone for the negative pass, train.py:137-138 — with a frozen/deterministic
+    backbone the features are identical, so recomputing is pure waste).
+    """
+    dtype = jnp.bfloat16 if config.half_precision else None
+    k = config.relocalization_k_size
+    delta4d = None
+    if k > 1:
+        corr, delta4d = correlation_maxpool4d(feat_a, feat_b, k)
+    else:
+        corr = correlation_4d(feat_a, feat_b)
+
+    corr = mutual_matching(corr)
+    corr = neigh_consensus_apply(
+        nc_params,
+        corr.astype(dtype) if dtype else corr,
+        symmetric=config.symmetric_mode,
+        impl=config.conv4d_impl,
+        remat=config.nc_remat,
+    )
+    corr = mutual_matching(corr).astype(jnp.float32)
+    if k > 1:
+        return corr, delta4d
+    return corr
+
+
+def extract_features(params, config: ImMatchNetConfig, image):
+    dtype = jnp.bfloat16 if config.half_precision else None
+    return feature_extraction_apply(
+        params["feature_extraction"],
+        image,
+        cnn=config.feature_extraction_cnn,
+        normalize=config.normalize_features,
+        dtype=dtype,
+    )
+
+
+def immatchnet_apply(params, config: ImMatchNetConfig, source_image, target_image):
+    """Forward pass.
+
+    Args:
+      params: from `init_immatchnet` (or converted torch checkpoint).
+      source_image, target_image: ``[b, h, w, 3]`` ImageNet-normalized, NHWC.
+
+    Returns:
+      ``corr4d`` of shape ``[b, iA, jA, iB, jB]`` in float32; when
+      ``config.relocalization_k_size > 1`` returns ``(corr4d, delta4d)`` with
+      ``delta4d = (di, dj, dk, dl)`` fine-offset tensors.
+    """
+    feat_a = extract_features(params, config, source_image)
+    feat_b = extract_features(params, config, target_image)
+    return match_pipeline(params["neigh_consensus"], config, feat_a, feat_b)
+
+
+class ImMatchNet:
+    """Convenience object bundling config + params with a jitted forward.
+
+    The functional API (`init_immatchnet` / `immatchnet_apply`) is the
+    primitive; this wrapper is for scripts and notebooks.
+    """
+
+    def __init__(
+        self,
+        config: Optional[ImMatchNetConfig] = None,
+        params=None,
+        rng: Optional[jax.Array] = None,
+        checkpoint: Optional[str] = None,
+    ):
+        if checkpoint:
+            from ncnet_tpu.train.checkpoint import load_checkpoint
+
+            loaded = load_checkpoint(checkpoint)
+            config = loaded.config if config is None else config
+            params = loaded.params
+        if config is None:
+            config = ImMatchNetConfig()
+        if params is None:
+            params = init_immatchnet(
+                rng if rng is not None else jax.random.PRNGKey(0), config
+            )
+        self.config = config
+        self.params = params
+        self._forward = jax.jit(
+            lambda p, s, t: immatchnet_apply(p, config, s, t)
+        )
+
+    def __call__(self, source_image, target_image):
+        return self._forward(self.params, source_image, target_image)

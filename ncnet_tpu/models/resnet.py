@@ -1,0 +1,146 @@
+"""ResNet-101 feature trunk (to ``layer3``), NHWC, frozen eval-mode BN.
+
+Replicates the torchvision ResNet-101 architecture that the reference
+truncates after ``layer3`` (lib/model.py:37-44): stride-16 output with 1024
+channels. BatchNorm is always in inference mode (the reference freezes the
+backbone and calls ``.eval()``, lib/model.py:75-78,251), so BN is computed as
+a per-channel affine from stored running statistics.
+
+Parameter tree mirrors torchvision naming so checkpoint conversion
+(`ncnet_tpu.utils.convert_torch`) is a mechanical rename:
+
+  {'conv1': {'kernel'}, 'bn1': {scale, offset, mean, var},
+   'layer1': [block, ...], 'layer2': [...], 'layer3': [...]}
+
+block = {'conv1': .., 'bn1': .., 'conv2': .., 'bn2': .., 'conv3': .., 'bn3': ..,
+         'downsample_conv': .., 'downsample_bn': ..  (first block only)}
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+BN_EPS = 1e-5
+
+# (n_blocks, planes, stride) per stage; trunk stops after layer3.
+RESNET101_STAGES = ((3, 64, 1), (4, 128, 2), (23, 256, 2))
+EXPANSION = 4
+
+
+def _conv_init(rng, kh, kw, cin, cout):
+    # He-normal fan-out (torchvision's ResNet conv init).
+    fan_out = kh * kw * cout
+    std = (2.0 / fan_out) ** 0.5
+    return jax.random.normal(rng, (kh, kw, cin, cout)) * std
+
+
+def _bn_init(c):
+    return {
+        "scale": jnp.ones((c,)),
+        "offset": jnp.zeros((c,)),
+        "mean": jnp.zeros((c,)),
+        "var": jnp.ones((c,)),
+    }
+
+
+def _bn_apply(p, x):
+    inv = p["scale"] * lax.rsqrt(p["var"] + BN_EPS)
+    return x * inv + (p["offset"] - p["mean"] * inv)
+
+
+def _conv(x, kernel, stride=1, padding="SAME"):
+    return lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _max_pool_3x3_s2(x):
+    return lax.reduce_window(
+        x,
+        -jnp.inf,
+        lax.max,
+        window_dimensions=(1, 3, 3, 1),
+        window_strides=(1, 2, 2, 1),
+        padding=((0, 0), (1, 1), (1, 1), (0, 0)),
+    )
+
+
+def _init_bottleneck(rng, cin, planes, stride, downsample):
+    keys = jax.random.split(rng, 4)
+    cout = planes * EXPANSION
+    p = {
+        "conv1": {"kernel": _conv_init(keys[0], 1, 1, cin, planes)},
+        "bn1": _bn_init(planes),
+        "conv2": {"kernel": _conv_init(keys[1], 3, 3, planes, planes)},
+        "bn2": _bn_init(planes),
+        "conv3": {"kernel": _conv_init(keys[2], 1, 1, planes, cout)},
+        "bn3": _bn_init(cout),
+    }
+    if downsample:
+        p["downsample_conv"] = {"kernel": _conv_init(keys[3], 1, 1, cin, cout)}
+        p["downsample_bn"] = _bn_init(cout)
+    return p
+
+
+def _apply_bottleneck(p, x, stride):
+    # torchvision v1.5 bottleneck: the stride sits on the 3x3 conv2. Padding
+    # is explicit (1, 1): XLA "SAME" at stride 2 pads (0, 1), which would
+    # shift sample positions relative to torch's symmetric pad=1.
+    out = jax.nn.relu(_bn_apply(p["bn1"], _conv(x, p["conv1"]["kernel"])))
+    out = jax.nn.relu(
+        _bn_apply(
+            p["bn2"],
+            _conv(out, p["conv2"]["kernel"], stride=stride, padding=((1, 1), (1, 1))),
+        )
+    )
+    out = _bn_apply(p["bn3"], _conv(out, p["conv3"]["kernel"]))
+    if "downsample_conv" in p:
+        shortcut = _bn_apply(
+            p["downsample_bn"], _conv(x, p["downsample_conv"]["kernel"], stride=stride)
+        )
+    else:
+        shortcut = x
+    return jax.nn.relu(out + shortcut)
+
+
+def init_resnet101_trunk(rng):
+    """Random (He) init; real use loads converted torchvision weights."""
+    n_stage_keys = len(RESNET101_STAGES)
+    keys = jax.random.split(rng, n_stage_keys + 1)
+    params = {
+        "conv1": {"kernel": _conv_init(keys[0], 7, 7, 3, 64)},
+        "bn1": _bn_init(64),
+    }
+    cin = 64
+    for si, (n_blocks, planes, stride) in enumerate(RESNET101_STAGES):
+        block_keys = jax.random.split(keys[si + 1], n_blocks)
+        blocks = []
+        for bi in range(n_blocks):
+            blocks.append(
+                _init_bottleneck(
+                    block_keys[bi],
+                    cin,
+                    planes,
+                    stride if bi == 0 else 1,
+                    downsample=(bi == 0),
+                )
+            )
+            cin = planes * EXPANSION
+        params[f"layer{si + 1}"] = blocks
+    return params
+
+
+def resnet101_trunk_apply(params, x):
+    """``[b, h, w, 3]`` normalized image -> ``[b, h/16, w/16, 1024]``."""
+    x = _conv(x, params["conv1"]["kernel"], stride=2, padding=((3, 3), (3, 3)))
+    x = jax.nn.relu(_bn_apply(params["bn1"], x))
+    x = _max_pool_3x3_s2(x)
+    for si, (n_blocks, _, stride) in enumerate(RESNET101_STAGES):
+        blocks = params[f"layer{si + 1}"]
+        for bi in range(n_blocks):
+            x = _apply_bottleneck(blocks[bi], x, stride if bi == 0 else 1)
+    return x

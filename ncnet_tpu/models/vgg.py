@@ -1,0 +1,67 @@
+"""VGG-16 feature trunk (to ``pool4``), NHWC.
+
+The reference's ``feature_extraction_cnn='vgg'`` variant truncates
+torchvision VGG-16 at ``pool4`` (lib/model.py:24-35): stride-16 output with
+512 channels, no BatchNorm. Parameter tree is a flat list of conv layers in
+torchvision ``features`` order so conversion is index-based.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# torchvision vgg16.features layout up to pool4:
+# (out_channels per conv; 'M' = 2x2/2 max-pool)
+VGG16_TO_POOL4 = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M")
+
+
+def init_vgg16_trunk(rng):
+    params = []
+    cin = 3
+    convs = [c for c in VGG16_TO_POOL4 if c != "M"]
+    keys = jax.random.split(rng, len(convs))
+    ki = 0
+    for c in VGG16_TO_POOL4:
+        if c == "M":
+            continue
+        fan_in = 3 * 3 * cin
+        bound = (1.0 / fan_in) ** 0.5
+        k1, k2 = jax.random.split(keys[ki])
+        params.append(
+            {
+                "kernel": jax.random.uniform(
+                    k1, (3, 3, cin, c), minval=-bound, maxval=bound
+                ),
+                "bias": jax.random.uniform(k2, (c,), minval=-bound, maxval=bound),
+            }
+        )
+        cin = c
+        ki += 1
+    return params
+
+
+def vgg16_trunk_apply(params, x):
+    """``[b, h, w, 3]`` -> ``[b, h/16, w/16, 512]`` (through pool4)."""
+    li = 0
+    for c in VGG16_TO_POOL4:
+        if c == "M":
+            x = lax.reduce_window(
+                x,
+                -jnp.inf,
+                lax.max,
+                window_dimensions=(1, 2, 2, 1),
+                window_strides=(1, 2, 2, 1),
+                padding="VALID",
+            )
+        else:
+            p = params[li]
+            x = lax.conv_general_dilated(
+                x,
+                p["kernel"],
+                window_strides=(1, 1),
+                padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            x = jax.nn.relu(x + p["bias"])
+            li += 1
+    return x

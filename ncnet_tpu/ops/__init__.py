@@ -1,0 +1,42 @@
+"""Pure-functional op library (no module state, no framework deps).
+
+Every op here mirrors math documented in SURVEY.md §2 against the reference
+(GrumpyZhou/ncnet), but is written channels-last and XLA-first.
+"""
+
+from ncnet_tpu.ops.conv4d import conv4d
+from ncnet_tpu.ops.coords import (
+    normalize_axis,
+    points_to_pixel_coords,
+    points_to_unit_coords,
+    unnormalize_axis,
+)
+from ncnet_tpu.ops.correlation import correlation_4d, correlation_maxpool4d
+from ncnet_tpu.ops.image import imagenet_normalize, resize_bilinear_align_corners
+from ncnet_tpu.ops.matches import (
+    bilinear_point_transfer,
+    corr_to_matches,
+    nearest_point_transfer,
+)
+from ncnet_tpu.ops.matching import maxpool4d, mutual_matching
+from ncnet_tpu.ops.metrics import pck
+from ncnet_tpu.ops.norm import feature_l2norm
+
+__all__ = [
+    "conv4d",
+    "correlation_4d",
+    "correlation_maxpool4d",
+    "corr_to_matches",
+    "bilinear_point_transfer",
+    "nearest_point_transfer",
+    "maxpool4d",
+    "mutual_matching",
+    "feature_l2norm",
+    "pck",
+    "normalize_axis",
+    "unnormalize_axis",
+    "points_to_unit_coords",
+    "points_to_pixel_coords",
+    "imagenet_normalize",
+    "resize_bilinear_align_corners",
+]

@@ -1,0 +1,116 @@
+"""All-pairs 4D feature correlation, plain and fused with 4D max-pooling.
+
+The correlation tensor is the framework's central object:
+``corr[b, iA, jA, iB, jB] = <fA[b, iA, jA, :], fB[b, iB, jB, :]>``.
+
+Reference semantics: ``FeatureCorrelation(shape='4D')`` (lib/model.py:106-115),
+which computes a batched GEMM between flattened feature maps. Here it is a
+single einsum, which XLA lowers to one large MXU matmul; features are
+channels-last (NHWC).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.ops.norm import feature_l2norm
+
+
+def correlation_4d(feature_a, feature_b, normalization=False, relu=True):
+    """All-pairs dot-product correlation.
+
+    Args:
+      feature_a: ``[b, hA, wA, c]`` source feature map (channels-last).
+      feature_b: ``[b, hB, wB, c]`` target feature map.
+      normalization: if True, apply (optional ReLU then) per-location L2
+        normalization over the flattened B grid, mirroring the reference's
+        ``FeatureCorrelation(normalization=True)`` branch (lib/model.py:117-118).
+        ImMatchNet uses ``normalization=False`` (lib/model.py:235).
+      relu: only used when ``normalization`` is True.
+
+    Returns:
+      ``[b, hA, wA, hB, wB]`` correlation tensor (no channel axis).
+    """
+    corr = jnp.einsum(
+        "bijc,bklc->bijkl",
+        feature_a,
+        feature_b,
+        preferred_element_type=feature_a.dtype,
+    )
+    if normalization:
+        if relu:
+            corr = jax.nn.relu(corr)
+        b, ha, wa, hb, wb = corr.shape
+        corr = feature_l2norm(corr.reshape(b, ha, wa, hb * wb), axis=-1)
+        corr = corr.reshape(b, ha, wa, hb, wb)
+    return corr
+
+
+def correlation_maxpool4d(feature_a, feature_b, k_size):
+    """Fused correlation + 4D max-pool ("relocalization"), HBM-friendly.
+
+    Equivalent to ``maxpool4d(correlation_4d(fA, fB), k_size)`` — the
+    reference computes the full high-resolution correlation and then pools it
+    (lib/model.py:269-272, 177-191) — but never materializes the pre-pool
+    tensor: the feature grids are split into ``k_size``-strided sub-grids and
+    the ``k_size**4`` sub-correlations are max-accumulated one at a time with
+    `lax.scan`, so peak HBM is O(pooled size), a ``k_size**4`` (16x for k=2)
+    reduction.
+
+    Args:
+      feature_a: ``[b, hA, wA, c]`` with hA, wA divisible by k_size.
+      feature_b: ``[b, hB, wB, c]`` with hB, wB divisible by k_size.
+      k_size: pooling factor applied to all four correlation dims.
+
+    Returns:
+      ``(corr, (di, dj, dk, dl))`` where ``corr`` is the pooled
+      ``[b, hA/k, wA/k, hB/k, wB/k]`` tensor and the deltas are int32 tensors
+      of the same shape giving the within-cell offset of the max along each of
+      the four dims — identical to the reference's ``maxpool4d`` outputs.
+    """
+    k = int(k_size)
+    b, ha, wa, c = feature_a.shape
+    _, hb, wb, _ = feature_b.shape
+    # [b, hA/k, k, wA/k, k, c] -> [k, k, b, hA/k, wA/k, c] -> [k*k, ...]
+    sub_a = feature_a.reshape(b, ha // k, k, wa // k, k, c)
+    sub_a = sub_a.transpose(2, 4, 0, 1, 3, 5).reshape(k * k, b, ha // k, wa // k, c)
+    sub_b = feature_b.reshape(b, hb // k, k, wb // k, k, c)
+    sub_b = sub_b.transpose(2, 4, 0, 1, 3, 5).reshape(k * k, b, hb // k, wb // k, c)
+
+    pooled_shape = (b, ha // k, wa // k, hb // k, wb // k)
+    neg_inf = jnp.finfo(feature_a.dtype).min
+
+    def step(carry, ab):
+        best, best_idx = carry
+        idx_a, idx_b = ab
+        corr = jnp.einsum(
+            "bijc,bklc->bijkl",
+            sub_a[idx_a],
+            sub_b[idx_b],
+            preferred_element_type=feature_a.dtype,
+        )
+        # Combined offset index in the reference's slice enumeration order
+        # (i, j, k, l) with i slowest (lib/model.py:179-184): the A sub-grid
+        # offsets (i, j) come from idx_a, B's (k, l) from idx_b.
+        combo = idx_a * (k * k) + idx_b
+        take = corr > best
+        best = jnp.where(take, corr, best)
+        best_idx = jnp.where(take, combo, best_idx)
+        return (best, best_idx), None
+
+    init = (
+        jnp.full(pooled_shape, neg_inf, feature_a.dtype),
+        jnp.zeros(pooled_shape, jnp.int32),
+    )
+    idx_a_grid, idx_b_grid = jnp.meshgrid(
+        jnp.arange(k * k), jnp.arange(k * k), indexing="ij"
+    )
+    (corr, best_idx), _ = jax.lax.scan(
+        step, init, (idx_a_grid.reshape(-1), idx_b_grid.reshape(-1))
+    )
+    # Decode combo -> (di, dj, dk, dl), i slowest, matching the reference's
+    # fmod/div decode (lib/model.py:185-189).
+    dl = best_idx % k
+    dk = (best_idx // k) % k
+    dj = (best_idx // (k * k)) % k
+    di = best_idx // (k * k * k)
+    return corr, (di, dj, dk, dl)

@@ -1,0 +1,65 @@
+"""Host/device image preprocessing ops.
+
+The reference resizes images with an identity-affine ``F.grid_sample``
+(lib/transformation.py:41-63) and ``F.upsample(mode='bilinear')``
+(eval_inloc.py:84-89); under PyTorch 0.3 both use align_corners=True
+semantics, i.e. sampling at ``linspace(0, L-1, out)``. `jax.image.resize`
+uses half-pixel centers, so a dedicated align-corners bilinear resize is
+provided for parity.
+"""
+
+import jax.numpy as jnp
+
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def imagenet_normalize(image, scale_255=True):
+    """ImageNet normalization, channels-last.
+
+    ``(image/255 - mean) / std`` — reference ``NormalizeImageDict``
+    (lib/normalization.py:19-27).
+    """
+    mean = jnp.asarray(IMAGENET_MEAN, image.dtype)
+    std = jnp.asarray(IMAGENET_STD, image.dtype)
+    if scale_255:
+        image = image / 255.0
+    return (image - mean) / std
+
+
+def imagenet_unnormalize(image):
+    """Inverse of `imagenet_normalize` (without the 255 scale)."""
+    mean = jnp.asarray(IMAGENET_MEAN, image.dtype)
+    std = jnp.asarray(IMAGENET_STD, image.dtype)
+    return image * std + mean
+
+
+def resize_bilinear_align_corners(image, out_h, out_w):
+    """Bilinear resize with align-corners sample positions.
+
+    Matches PyTorch-0.3 ``grid_sample`` on an identity affine grid and
+    ``upsample(mode='bilinear')``: output pixel ``o`` samples input position
+    ``o * (L_in - 1) / (L_out - 1)``.
+
+    Args:
+      image: ``[..., h, w, c]``.
+    """
+    h, w = image.shape[-3], image.shape[-2]
+
+    def interp(x, axis, out_n, in_n):
+        if out_n == in_n:
+            return x
+        pos = jnp.linspace(0.0, in_n - 1.0, out_n)
+        lo = jnp.floor(pos).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, in_n - 1)
+        frac = (pos - lo).astype(x.dtype)
+        shape = [1] * x.ndim
+        shape[axis] = out_n
+        frac = frac.reshape(shape)
+        return jnp.take(x, lo, axis=axis) * (1 - frac) + jnp.take(
+            x, hi, axis=axis
+        ) * frac
+
+    image = interp(image, image.ndim - 3, out_h, h)
+    image = interp(image, image.ndim - 2, out_w, w)
+    return image

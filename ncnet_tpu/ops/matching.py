@@ -1,0 +1,53 @@
+"""Soft mutual-nearest-neighbour filtering and 4D max-pooling."""
+
+import jax.numpy as jnp
+
+
+def mutual_matching(corr, eps=1e-5):
+    """Soft mutual-NN gate on a 4D correlation tensor.
+
+    ``out = corr * (corr / (max_over_B + eps)) * (corr / (max_over_A + eps))``
+    where ``max_over_A`` reduces over the (iA, jA) dims and ``max_over_B``
+    over (iB, jB). Mirrors the reference ``MutualMatching``
+    (lib/model.py:155-175), eps 1e-5; the two ratio factors are multiplied
+    together before scaling ``corr`` so the output is symmetric in A/B.
+
+    Args:
+      corr: ``[b, iA, jA, iB, jB]``.
+    """
+    max_over_a = jnp.max(corr, axis=(1, 2), keepdims=True)
+    max_over_b = jnp.max(corr, axis=(3, 4), keepdims=True)
+    ratio_b = corr / (max_over_a + eps)  # best-over-A normalization
+    ratio_a = corr / (max_over_b + eps)  # best-over-B normalization
+    return corr * (ratio_a * ratio_b)
+
+
+def maxpool4d(corr, k_size):
+    """4D max-pool with stride ``k_size`` over all four dims, with offsets.
+
+    Returns the pooled tensor plus the within-cell argmax offsets
+    ``(di, dj, dk, dl)`` used to restore fine coordinates at readout —
+    reference ``maxpool4d`` (lib/model.py:177-191). Offset encoding matches
+    the reference slice enumeration: combined index ``((di*k+dj)*k+dk)*k+dl``.
+
+    Args:
+      corr: ``[b, iA, jA, iB, jB]`` with all four spatial dims divisible by
+        ``k_size``.
+
+    Returns:
+      ``(pooled, (di, dj, dk, dl))``; pooled is
+      ``[b, iA/k, jA/k, iB/k, jB/k]``, offsets are int32 of the same shape.
+    """
+    k = int(k_size)
+    b, d1, d2, d3, d4 = corr.shape
+    blocks = corr.reshape(b, d1 // k, k, d2 // k, k, d3 // k, k, d4 // k, k)
+    # -> [b, d1/k, d2/k, d3/k, d4/k, k, k, k, k]
+    blocks = blocks.transpose(0, 1, 3, 5, 7, 2, 4, 6, 8)
+    flat = blocks.reshape(b, d1 // k, d2 // k, d3 // k, d4 // k, k**4)
+    pooled = jnp.max(flat, axis=-1)
+    idx = jnp.argmax(flat, axis=-1).astype(jnp.int32)
+    dl = idx % k
+    dk = (idx // k) % k
+    dj = (idx // (k * k)) % k
+    di = idx // (k * k * k)
+    return pooled, (di, dj, dk, dl)

@@ -1,0 +1,32 @@
+"""Evaluation metrics."""
+
+import jax.numpy as jnp
+
+
+def pck(source_points, warped_points, l_pck, alpha=0.1):
+    """Percentage of Correct Keypoints.
+
+    Fraction of valid keypoints whose warped position lies within
+    ``alpha * L_pck`` of the ground-truth source position — reference ``pck``
+    (lib/eval_util.py:12-24). Valid keypoints are those not equal to the -1
+    padding in both coordinates of the source points (the reference slices
+    the first N valid columns; padding is trailing, so masking is
+    equivalent).
+
+    Args:
+      source_points: ``[b, 2, N]`` ground-truth points, -1-padded.
+      warped_points: ``[b, 2, N]`` model-warped points.
+      l_pck: ``[b]`` or ``[b, 1]`` per-sample reference length.
+      alpha: threshold fraction (0.1).
+
+    Returns:
+      ``[b]`` per-sample PCK in [0, 1].
+    """
+    l_pck = jnp.reshape(l_pck, (-1,))
+    valid = (source_points[:, 0, :] != -1) & (source_points[:, 1, :] != -1)
+    dist = jnp.sqrt(
+        jnp.sum(jnp.square(source_points - warped_points), axis=1)
+    )
+    correct = (dist <= l_pck[:, None] * alpha) & valid
+    n_valid = jnp.sum(valid, axis=1)
+    return jnp.sum(correct, axis=1) / jnp.maximum(n_valid, 1)

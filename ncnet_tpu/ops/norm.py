@@ -1,0 +1,15 @@
+"""Feature normalization."""
+
+import jax.numpy as jnp
+
+
+def feature_l2norm(x, axis=-1, eps=1e-6):
+    """Per-location L2 normalization along ``axis``.
+
+    Matches the reference ``featureL2Norm`` (lib/model.py:14-17):
+    ``x / sqrt(sum(x**2, axis) + eps)`` with ``eps = 1e-6`` added to the sum
+    of squares (inside the square root), channel axis here defaulting to the
+    trailing (channels-last) axis instead of the reference's dim 1.
+    """
+    denom = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return x / denom

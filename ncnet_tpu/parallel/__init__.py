@@ -1,0 +1,5 @@
+"""Parallelism: device meshes, sharding helpers, spatially-sharded ops."""
+
+from ncnet_tpu.parallel.mesh import make_mesh, replicate, shard_batch
+
+__all__ = ["make_mesh", "replicate", "shard_batch"]

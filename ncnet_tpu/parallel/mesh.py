@@ -1,0 +1,35 @@
+"""Device mesh and sharding helpers.
+
+The reference is single-GPU (SURVEY.md §2.3: no DataParallel, no NCCL/MPI).
+Scaling here is mesh-native: a `jax.sharding.Mesh` with a ``data`` axis for
+batch data parallelism and a ``spatial`` axis for sharding the 4D
+correlation tensor over its (iA, jA) dims (the long-context analog; see
+`ncnet_tpu.parallel.spatial`). Collectives ride ICI/DCN via XLA.
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(mesh_shape=None, axis_names=("data",), devices=None):
+    """Create a mesh. Default: all devices on a single ``data`` axis."""
+    if devices is None:
+        devices = jax.devices()
+    if mesh_shape is None:
+        mesh_shape = (len(devices),)
+    dev_array = np.asarray(devices).reshape(mesh_shape)
+    return Mesh(dev_array, axis_names)
+
+
+def shard_batch(mesh, batch, axis="data"):
+    """Put a batch dict on device, sharded along the leading (batch) dim."""
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def replicate(mesh, tree):
+    """Replicate a pytree (params, opt state) across the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)

@@ -1,0 +1,158 @@
+"""Spatial sharding of the 4D correlation tensor — the long-context analog.
+
+The correlation tensor is O((h*w)^2); at InLoc resolution (grid ~200x150)
+it dwarfs HBM. The reference mitigates with fp16 + 4D max-pooling
+(SURVEY.md §5); here the additional TPU-native axis is to shard corr4d over
+its iA dim across a ``spatial`` mesh axis: every device holds the full B
+grid x a slab of A rows. This is the direct ring-attention-style
+decomposition over ICI:
+
+  * correlation: local einsum of the A-row slab against replicated B;
+  * mutual matching: max over B is local; max over A is a cross-device
+    `lax.pmax`;
+  * conv4d: needs ``ki//2`` halo rows of iA from ring neighbours —
+    exchanged with `lax.ppermute` (non-cyclic, so edge devices receive
+    zeros = the zero-padding semantics of the reference conv4d);
+  * symmetric NeighConsensus applies the net to the A<->B transposed tensor
+    too; the transpose moves the sharded dim, implemented with
+    `lax.all_to_all` (iA-sharded <-> iB-sharded).
+
+All collectives are expressed inside one `shard_map`, compiled by XLA onto
+ICI.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ncnet_tpu.ops.conv4d import conv4d
+from ncnet_tpu.ops.correlation import correlation_4d
+
+
+def _pmax(x, axis_name):
+    return lax.pmax(x, axis_name)
+
+
+def mutual_matching_sharded(corr, axis_name, eps=1e-5):
+    """`ops.matching.mutual_matching` for an iA-sharded slab."""
+    local_max_a = jnp.max(corr, axis=(1, 2), keepdims=True)
+    max_over_a = _pmax(local_max_a, axis_name)
+    max_over_b = jnp.max(corr, axis=(3, 4), keepdims=True)  # B dims are local
+    ratio_b = corr / (max_over_a + eps)
+    ratio_a = corr / (max_over_b + eps)
+    return corr * (ratio_a * ratio_b)
+
+
+def halo_exchange_rows(x, axis_name, halo):
+    """Concatenate ``halo`` rows of dim 1 from ring neighbours (zeros at the
+    ends — matching zero padding)."""
+    n = lax.axis_size(axis_name)
+    fwd = [(i, i + 1) for i in range(n - 1)]  # send right
+    bwd = [(i + 1, i) for i in range(n - 1)]  # send left
+    from_left = lax.ppermute(x[:, -halo:], axis_name, fwd)
+    from_right = lax.ppermute(x[:, :halo], axis_name, bwd)
+    # ppermute delivers zeros where no peer sends, so edges are zero-padded.
+    return jnp.concatenate([from_left, x, from_right], axis=1)
+
+
+def conv4d_sharded(x, w, bias, axis_name, impl="xla"):
+    """conv4d on an iA-sharded ``[b, iA_loc, jA, iB, jB, c]`` slab."""
+    ki = w.shape[0]
+    halo = ki // 2
+    if halo:
+        x = halo_exchange_rows(x, axis_name, halo)
+    out = conv4d(x, w, bias=bias, impl=impl)
+    if halo:
+        out = out[:, halo:-halo]
+    return out
+
+
+def _swap_ab_sharded(x, axis_name):
+    """Global A<->B transpose of an iA-sharded slab -> an (originally) iB
+    -sharded slab, via all_to_all: split the local iB dim across devices,
+    gather all local-iA slabs."""
+    # x: [b, ia_loc, jA, iB, jB, c] -> all_to_all splits iB (axis 3),
+    # concatenates ia shards (axis 1) -> [b, iA_full? ...]
+    y = lax.all_to_all(x, axis_name, split_axis=3, concat_axis=1, tiled=True)
+    # y: [b, iA_full, jA, iB_loc, jB, c]; transpose pairs
+    return y.transpose(0, 3, 4, 1, 2, 5)
+
+
+def neigh_consensus_sharded(params, corr, axis_name, symmetric=True, impl="xla"):
+    """Symmetric NC stack on an iA-sharded correlation slab (with channel
+    axis handling identical to `neigh_consensus_apply`)."""
+    dtype = corr.dtype
+
+    def net(x):
+        for p in params:
+            x = jax.nn.relu(
+                conv4d_sharded(
+                    x,
+                    p["kernel"].astype(dtype),
+                    p["bias"].astype(dtype),
+                    axis_name,
+                    impl=impl,
+                )
+            )
+        return x
+
+    x = corr[..., None]
+    if symmetric:
+        xt = _swap_ab_sharded(x, axis_name)
+        out = net(x) + _swap_ab_sharded(net(xt), axis_name)
+    else:
+        out = net(x)
+    return out[..., 0]
+
+
+def make_sharded_match_pipeline(config, mesh, axis_name="spatial"):
+    """Features -> filtered corr4d with the A grid sharded over ``axis_name``.
+
+    Returns a function ``(nc_params, feat_a, feat_b) -> corr4d`` where
+    ``feat_a`` is sharded over rows (dim 1) of the feature grid and the
+    output corr4d is sharded over iA. Relocalization is not supported on
+    the sharded path yet (the fused pool handles high-res instead).
+    """
+    if config.relocalization_k_size > 1:
+        raise NotImplementedError("sharded pipeline with relocalization")
+    n_shards = mesh.shape[axis_name]
+
+    def body(nc_params, feat_a, feat_b):
+        corr = correlation_4d(feat_a, feat_b)
+        corr = mutual_matching_sharded(corr, axis_name)
+        corr = neigh_consensus_sharded(
+            nc_params,
+            corr,
+            axis_name,
+            symmetric=config.symmetric_mode,
+            impl=config.conv4d_impl,
+        )
+        corr = mutual_matching_sharded(corr, axis_name).astype(jnp.float32)
+        return corr
+
+    mapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(None, axis_name), P()),
+        out_specs=P(None, axis_name),
+        check_vma=False,
+    )
+
+    def pipeline(nc_params, feat_a, feat_b):
+        if feat_a.shape[1] % n_shards:
+            raise ValueError(
+                f"A-grid rows ({feat_a.shape[1]}) must divide the "
+                f"'{axis_name}' axis size ({n_shards})"
+            )
+        if config.symmetric_mode and feat_b.shape[1] % n_shards:
+            raise ValueError(
+                "symmetric mode transposes A<->B, so B-grid rows "
+                f"({feat_b.shape[1]}) must also divide {n_shards} "
+                "(all_to_all resharding)"
+            )
+        return mapped(nc_params, feat_a, feat_b)
+
+    return pipeline

@@ -1,0 +1,5 @@
+"""Training: weak-supervision loss, jitted/sharded steps, checkpointing."""
+
+from ncnet_tpu.train import checkpoint, loss, step
+
+__all__ = ["checkpoint", "loss", "step"]

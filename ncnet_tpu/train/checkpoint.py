@@ -1,0 +1,99 @@
+"""Self-describing checkpoints.
+
+Like the reference's ``save_checkpoint`` (lib/torch_util.py:48-61,
+train.py:197-205) a checkpoint carries the architecture config with the
+weights, so eval tools need no flags. Unlike the reference, optimizer state
+and the step counter are saved too, making resume exact rather than
+weights-only (SURVEY.md §5 notes the reference's resume drops them).
+
+Format: a single msgpack file (flax.serialization) holding numpy-fied
+pytrees, plus the config as a plain dict. A ``best_<name>`` copy is written
+when the validation loss improves, mirroring the reference.
+"""
+
+import dataclasses
+import os
+import shutil
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from flax import serialization
+
+from ncnet_tpu.models.immatchnet import ImMatchNetConfig
+
+
+@dataclasses.dataclass
+class CheckpointData:
+    config: ImMatchNetConfig
+    params: Any
+    opt_state: Any = None
+    step: int = 0
+    epoch: int = 0
+    train_loss: Any = None
+    val_loss: Any = None
+    best_val_loss: Optional[float] = None
+
+
+def _to_numpy(tree):
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def _relistify(obj):
+    """Invert to_state_dict's list -> {'0': ..} conversion on restore."""
+    if isinstance(obj, dict):
+        if obj and all(k.isdigit() for k in obj):
+            keys = sorted(obj, key=int)
+            if [int(k) for k in keys] == list(range(len(keys))):
+                return [_relistify(obj[k]) for k in keys]
+        return {k: _relistify(v) for k, v in obj.items()}
+    return obj
+
+
+def save_checkpoint(path, data: CheckpointData, is_best=False):
+    payload = {
+        "config": data.config.to_dict(),
+        "params": serialization.to_state_dict(_to_numpy(data.params)),
+        # to_state_dict turns tuple-structured pytrees (e.g. optax states)
+        # into msgpack-able dicts; restore needs a target pytree.
+        "opt_state": serialization.to_state_dict(_to_numpy(data.opt_state))
+        if data.opt_state is not None
+        else {},
+        "step": int(data.step),
+        "epoch": int(data.epoch),
+        "train_loss": np.asarray(
+            data.train_loss if data.train_loss is not None else []
+        ),
+        "val_loss": np.asarray(data.val_loss if data.val_loss is not None else []),
+        "best_val_loss": float(
+            data.best_val_loss if data.best_val_loss is not None else np.inf
+        ),
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(serialization.msgpack_serialize(payload))
+    if is_best:
+        base = os.path.basename(path)
+        best = os.path.join(os.path.dirname(os.path.abspath(path)), "best_" + base)
+        shutil.copyfile(path, best)
+
+
+def load_checkpoint(path, opt_state_target=None) -> CheckpointData:
+    """Load a checkpoint. To restore optimizer state into the right pytree
+    structure, pass a freshly-initialized ``opt_state_target``."""
+    with open(path, "rb") as f:
+        payload = serialization.msgpack_restore(f.read())
+    config = ImMatchNetConfig.from_dict(payload["config"])
+    opt_state = payload.get("opt_state") or None
+    if opt_state is not None and opt_state_target is not None:
+        opt_state = serialization.from_state_dict(opt_state_target, opt_state)
+    return CheckpointData(
+        config=config,
+        params=_relistify(payload["params"]),
+        opt_state=opt_state,
+        step=int(payload.get("step", 0)),
+        epoch=int(payload.get("epoch", 0)),
+        train_loss=payload.get("train_loss"),
+        val_loss=payload.get("val_loss"),
+        best_val_loss=payload.get("best_val_loss"),
+    )

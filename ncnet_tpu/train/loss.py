@@ -1,0 +1,62 @@
+"""Weakly-supervised matching loss.
+
+Reference ``weak_loss`` (train.py:110-156): normalize match scores over the
+source dimension (softmax by default), take the per-cell max in both
+matching directions, average, and subtract the same quantity computed on
+negative pairs formed by rolling the source-image batch by one
+(``np.roll(arange(b), -1)``, train.py:137): ``loss = score_neg - score_pos``.
+
+The reference mutates the batch in place to build negatives; here the roll
+is applied functionally to the *extracted source features* (identical result
+— the backbone is deterministic — at half the backbone cost).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.models.immatchnet import extract_features, match_pipeline
+
+
+def _normalize(x, axis, normalization):
+    if normalization is None or normalization == "none":
+        return x
+    if normalization == "softmax":
+        return jax.nn.softmax(x, axis=axis)
+    if normalization == "l1":
+        return x / (jnp.sum(x, axis=axis, keepdims=True) + 1e-4)
+    raise ValueError(f"unknown score normalization {normalization!r}")
+
+
+def match_score(corr, normalization="softmax"):
+    """Mean of the best normalized match score, both directions.
+
+    ``corr``: ``[b, fs1, fs2, fs3, fs4]``. Returns a scalar: the
+    reference's ``mean(scores_A + scores_B) / 2`` (train.py:125-134).
+    """
+    b, fs1, fs2, fs3, fs4 = corr.shape
+    b_avec = corr.reshape(b, fs1 * fs2, fs3, fs4)  # scores over A per B cell
+    a_bvec = corr.reshape(b, fs1, fs2, fs3 * fs4)  # scores over B per A cell
+    scores_b = jnp.max(_normalize(b_avec, 1, normalization), axis=1)
+    scores_a = jnp.max(_normalize(a_bvec, 3, normalization), axis=3)
+    return (jnp.mean(scores_a) + jnp.mean(scores_b)) / 2
+
+
+def weak_loss(params, config, batch, normalization="softmax"):
+    """Positive-vs-rolled-negative weak supervision loss (scalar)."""
+    if config.relocalization_k_size > 1:
+        raise ValueError(
+            "weak_loss does not support relocalization configs "
+            "(the reference trains with relocalization_k_size=0; "
+            "relocalization is an eval-time memory optimization)"
+        )
+    feat_a = extract_features(params, config, batch["source_image"])
+    feat_b = extract_features(params, config, batch["target_image"])
+
+    corr_pos = match_pipeline(params["neigh_consensus"], config, feat_a, feat_b)
+    score_pos = match_score(corr_pos, normalization)
+
+    feat_a_neg = jnp.roll(feat_a, -1, axis=0)
+    corr_neg = match_pipeline(params["neigh_consensus"], config, feat_a_neg, feat_b)
+    score_neg = match_score(corr_neg, normalization)
+
+    return score_neg - score_pos

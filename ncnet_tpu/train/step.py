@@ -1,0 +1,76 @@
+"""Jitted training/eval steps with a frozen-trunk / trainable-head split.
+
+The reference trains only the NeighConsensus head by default (backbone
+frozen, train.py:60-71, Adam lr 5e-4). Here the trainable subset is an
+explicit sub-pytree, so gradients are only computed and optimizer state only
+kept for what actually trains.
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import optax
+
+from ncnet_tpu.train.loss import weak_loss
+
+
+class TrainState(NamedTuple):
+    params: Any  # full model params (trunk + head)
+    opt_state: Any
+    step: Any
+
+
+def trainable_subset(params, train_fe=False):
+    """The trainable sub-pytree: the NC head, plus the trunk if train_fe."""
+    if train_fe:
+        return dict(params)
+    return {"neigh_consensus": params["neigh_consensus"]}
+
+
+def make_optimizer(learning_rate=5e-4):
+    return optax.adam(learning_rate)
+
+
+def create_train_state(params, optimizer, train_fe=False):
+    opt_state = optimizer.init(trainable_subset(params, train_fe))
+    return TrainState(params=params, opt_state=opt_state, step=0)
+
+
+def make_train_step(
+    config, optimizer, train_fe=False, normalization="softmax", donate=True
+):
+    """Returns ``step(state, batch) -> (state, loss)``, jit-compiled.
+
+    ``batch`` is a dict with ``source_image``/``target_image`` ``[b,h,w,3]``
+    (ImageNet-normalized NHWC). Under a `jax.sharding.Mesh` with the batch
+    sharded over the data axis and params replicated, XLA inserts the
+    gradient all-reduce automatically; no hand-written collectives needed.
+    """
+
+    def loss_fn(trainable, params, batch):
+        merged = dict(params)
+        merged.update(trainable)
+        return weak_loss(merged, config, batch, normalization)
+
+    def step_fn(state, batch):
+        trainable = trainable_subset(state.params, train_fe)
+        loss, grads = jax.value_and_grad(loss_fn)(trainable, state.params, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state, trainable)
+        new_trainable = optax.apply_updates(trainable, updates)
+        params = dict(state.params)
+        params.update(new_trainable)
+        return (
+            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
+            loss,
+        )
+
+    return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(config, normalization="softmax"):
+    """Validation loss on a batch (reference process_epoch('test'))."""
+
+    def eval_fn(params, batch):
+        return weak_loss(params, config, batch, normalization)
+
+    return jax.jit(eval_fn)

@@ -1,0 +1,5 @@
+"""Utilities: torch checkpoint conversion, profiling, misc helpers."""
+
+from ncnet_tpu.utils import convert_torch
+
+__all__ = ["convert_torch"]

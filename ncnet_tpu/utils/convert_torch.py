@@ -1,0 +1,151 @@
+"""Convert reference PyTorch checkpoints (.pth.tar) to ncnet_tpu params.
+
+The reference checkpoint schema (train.py:197-205) is
+``{epoch, args, state_dict, best_test_loss, optimizer, train_loss,
+test_loss}`` with state-dict keys like
+``FeatureExtraction.model.<idx>...`` (torchvision Sequential indices:
+0=conv1, 1=bn1, 4=layer1, 5=layer2, 6=layer3 for the resnet101 trunk) and
+``NeighConsensus.conv.<2*i>.{weight,bias}`` for the Conv4d layers.
+
+Conv4d weights are stored PRE-PERMUTED by the reference constructor
+(lib/conv4d.py:72-77): ``[k1, c_out, c_in, k2, k3, k4]`` instead of torch's
+native ``[c_out, c_in, k1, k2, k3, k4]``.
+
+torch is only needed inside these functions (CPU-only is fine); the rest of
+the framework never imports it.
+"""
+
+import numpy as np
+
+
+def _np(t):
+    return np.asarray(t.detach().cpu().numpy(), dtype=np.float32)
+
+
+def _conv2d_kernel(t):
+    # torch [cout, cin, kh, kw] -> HWIO
+    return _np(t).transpose(2, 3, 1, 0)
+
+
+def _bn(sd, prefix):
+    return {
+        "scale": _np(sd[prefix + ".weight"]),
+        "offset": _np(sd[prefix + ".bias"]),
+        "mean": _np(sd[prefix + ".running_mean"]),
+        "var": _np(sd[prefix + ".running_var"]),
+    }
+
+
+def convert_resnet101_trunk(state_dict, prefix="FeatureExtraction.model."):
+    """torchvision-style resnet state dict -> `models.resnet` param tree.
+
+    Accepts either Sequential-index keys (``0.weight`` .. ``6.<block>...``,
+    as saved by the reference's truncated model) or attribute keys
+    (``conv1.weight``, ``layer1.0...``, as in raw torchvision checkpoints).
+    """
+    sd = {k[len(prefix):]: v for k, v in state_dict.items() if k.startswith(prefix)}
+    if not sd:
+        raise ValueError(f"no keys under prefix {prefix!r}")
+    # normalize Sequential indices to attribute names
+    seq_map = {"0": "conv1", "1": "bn1", "4": "layer1", "5": "layer2", "6": "layer3"}
+    norm = {}
+    for k, v in sd.items():
+        head, _, rest = k.partition(".")
+        if head in seq_map:
+            k = seq_map[head] + ("." + rest if rest else "")
+        norm[k] = v
+    sd = norm
+
+    from ncnet_tpu.models.resnet import RESNET101_STAGES
+
+    params = {
+        "conv1": {"kernel": _conv2d_kernel(sd["conv1.weight"])},
+        "bn1": _bn(sd, "bn1"),
+    }
+    for si, (n_blocks, _, _) in enumerate(RESNET101_STAGES):
+        layer = f"layer{si + 1}"
+        blocks = []
+        for bi in range(n_blocks):
+            p = f"{layer}.{bi}."
+            block = {}
+            for ci in (1, 2, 3):
+                block[f"conv{ci}"] = {
+                    "kernel": _conv2d_kernel(sd[p + f"conv{ci}.weight"])
+                }
+                block[f"bn{ci}"] = _bn(sd, p + f"bn{ci}")
+            if p + "downsample.0.weight" in sd:
+                block["downsample_conv"] = {
+                    "kernel": _conv2d_kernel(sd[p + "downsample.0.weight"])
+                }
+                block["downsample_bn"] = _bn(sd, p + "downsample.1")
+            blocks.append(block)
+        params[layer] = blocks
+    return params
+
+
+def convert_vgg16_trunk(state_dict, prefix="FeatureExtraction.model."):
+    """torchvision vgg16.features state dict (conv layers only, in order)."""
+    sd = {k[len(prefix):]: v for k, v in state_dict.items() if k.startswith(prefix)}
+    weights = sorted(
+        (int(k.split(".")[0]), k) for k in sd if k.endswith(".weight")
+    )
+    params = []
+    for idx, wkey in weights:
+        params.append(
+            {
+                "kernel": _conv2d_kernel(sd[wkey]),
+                "bias": _np(sd[f"{idx}.bias"]),
+            }
+        )
+    return params
+
+
+def convert_neigh_consensus(state_dict, prefix="NeighConsensus.conv.", pre_permuted=True):
+    """Conv4d stack -> list of {'kernel': [k,k,k,k,cin,cout], 'bias': [cout]}."""
+    sd = {k[len(prefix):]: v for k, v in state_dict.items() if k.startswith(prefix)}
+    indices = sorted({int(k.split(".")[0]) for k in sd})
+    params = []
+    for idx in indices:
+        w = _np(sd[f"{idx}.weight"])
+        if pre_permuted:
+            # [k1, cout, cin, k2, k3, k4] -> [cout, cin, k1, k2, k3, k4]
+            w = w.transpose(1, 2, 0, 3, 4, 5)
+        # [cout, cin, k1, k2, k3, k4] -> [k1, k2, k3, k4, cin, cout]
+        w = w.transpose(2, 3, 4, 5, 1, 0)
+        params.append({"kernel": w, "bias": _np(sd[f"{idx}.bias"])})
+    return params
+
+
+def convert_checkpoint(path):
+    """Load a reference .pth.tar and return ``(config, params)``.
+
+    Applies the reference's legacy key rename ``'vgg' -> 'model'``
+    (lib/model.py:214) and reads the architecture from the embedded args,
+    preserving the self-describing-checkpoint property.
+    """
+    import torch
+
+    from ncnet_tpu.models.immatchnet import ImMatchNetConfig
+
+    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    sd = {k.replace("vgg", "model"): v for k, v in ckpt["state_dict"].items()}
+    args = ckpt.get("args")
+    cnn = getattr(args, "fe_arch", None) or getattr(
+        args, "feature_extraction_cnn", "resnet101"
+    )
+    config = ImMatchNetConfig(
+        feature_extraction_cnn=cnn,
+        ncons_kernel_sizes=tuple(args.ncons_kernel_sizes),
+        ncons_channels=tuple(args.ncons_channels),
+    )
+    if cnn == "resnet101":
+        fe = convert_resnet101_trunk(sd)
+    elif cnn == "vgg":
+        fe = convert_vgg16_trunk(sd)
+    else:
+        raise ValueError(f"unsupported backbone in checkpoint: {cnn!r}")
+    params = {
+        "feature_extraction": fe,
+        "neigh_consensus": convert_neigh_consensus(sd),
+    }
+    return config, params

@@ -1,0 +1,79 @@
+"""InLoc match-dump CLI (reference eval_inloc.py equivalent).
+
+Writes matches/<experiment>/<q+1>.mat files consumed by the MATLAB
+PnP-RANSAC + pose-verification pipeline.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description="ncnet_tpu InLoc match dump")
+    p.add_argument("--checkpoint", type=str, required=True)
+    p.add_argument("--inloc_shortlist", type=str,
+                   default="datasets/inloc/densePE_top100_shortlist_cvpr18.mat")
+    p.add_argument("--k_size", type=int, default=2)
+    p.add_argument("--image_size", type=int, default=3200)
+    p.add_argument("--n_queries", type=int, default=356)
+    p.add_argument("--n_panos", type=int, default=10)
+    def str2bool(v):
+        return str(v).lower() in ("1", "true", "yes", "y")
+
+    p.add_argument("--matching_both_directions", type=str2bool, default=True)
+    p.add_argument("--flip_matching_direction", type=str2bool, default=False)
+    p.add_argument("--pano_path", type=str, default="datasets/inloc/pano/")
+    p.add_argument("--query_path", type=str, default="datasets/inloc/query/iphone7/")
+    p.add_argument("--output_root", type=str, default="matches")
+    args = p.parse_args()
+
+    if args.checkpoint.endswith((".pth.tar", ".pth")):
+        from ncnet_tpu.utils.convert_torch import convert_checkpoint
+
+        config, params = convert_checkpoint(args.checkpoint)
+    else:
+        from ncnet_tpu.train.checkpoint import load_checkpoint
+
+        ck = load_checkpoint(args.checkpoint)
+        config, params = ck.config, ck.params
+
+    # bf16 + relocalization: the memory toolkit of the reference eval
+    # (fp16 + maxpool4d, eval_inloc.py:50,32), TPU-native.
+    config = config.replace(
+        half_precision=True, relocalization_k_size=args.k_size
+    )
+
+    exp = os.path.basename(args.inloc_shortlist).split(".")[0]
+    exp += f"_SZ_NEW_{args.image_size}_K_{args.k_size}"
+    exp += "_AtoB" if args.flip_matching_direction else (
+        "_BOTHDIRS" if args.matching_both_directions else "_BtoA"
+    )
+    exp += "_SOFTMAX"
+    if args.checkpoint:
+        exp += "_CHECKPOINT_" + os.path.basename(args.checkpoint).split(".")[0]
+    out_dir = os.path.join(args.output_root, exp)
+    print(f"Output matches folder: {out_dir}")
+
+    from ncnet_tpu.eval.inloc import dump_matches
+
+    dump_matches(
+        params,
+        config,
+        shortlist_path=args.inloc_shortlist,
+        query_path=args.query_path,
+        pano_path=args.pano_path,
+        output_dir=out_dir,
+        image_size=args.image_size,
+        n_queries=args.n_queries,
+        n_panos=args.n_panos,
+        both_directions=args.matching_both_directions
+        and not args.flip_matching_direction,
+        flip_direction=args.flip_matching_direction,
+    )
+
+
+if __name__ == "__main__":
+    main()

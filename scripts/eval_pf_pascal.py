@@ -1,0 +1,48 @@
+"""PF-Pascal PCK evaluation CLI (reference eval_pf_pascal.py equivalent)."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description="ncnet_tpu PF-Pascal PCK eval")
+    p.add_argument("--checkpoint", type=str, required=True,
+                   help=".msgpack checkpoint or reference .pth.tar")
+    p.add_argument("--image_size", type=int, default=400)
+    p.add_argument("--eval_dataset_path", type=str, default="datasets/pf-pascal")
+    p.add_argument("--batch_size", type=int, default=1)
+    p.add_argument("--num_workers", type=int, default=4)
+    args = p.parse_args()
+
+    from ncnet_tpu.data.loader import DataLoader
+    from ncnet_tpu.data.pairs import PFPascalDataset
+    from ncnet_tpu.eval.pf_pascal import evaluate
+
+    if args.checkpoint.endswith((".pth.tar", ".pth")):
+        from ncnet_tpu.utils.convert_torch import convert_checkpoint
+
+        config, params = convert_checkpoint(args.checkpoint)
+    else:
+        from ncnet_tpu.train.checkpoint import load_checkpoint
+
+        ck = load_checkpoint(args.checkpoint)
+        config, params = ck.config, ck.params
+
+    dataset = PFPascalDataset(
+        os.path.join(args.eval_dataset_path, "image_pairs", "test_pairs.csv"),
+        args.eval_dataset_path,
+        output_size=(args.image_size, args.image_size),
+        pck_procedure="scnet",
+    )
+    loader = DataLoader(dataset, args.batch_size, num_workers=args.num_workers)
+    stats = evaluate(params, config, loader)
+    print(f"Total: {len(dataset)}")
+    print(f"Valid: {stats['n_valid']}")
+    print(f"PCK: {stats['pck']:.2%}")
+
+
+if __name__ == "__main__":
+    main()

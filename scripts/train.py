@@ -1,0 +1,111 @@
+"""Weakly-supervised training CLI (reference train.py equivalent).
+
+Example (PF-Pascal paper config, reference README.md:42):
+  python scripts/train.py --dataset_image_path datasets/pf-pascal \
+      --dataset_csv_path datasets/pf-pascal/image_pairs \
+      --ncons_kernel_sizes 5 5 5 --ncons_channels 16 16 1
+
+With no dataset on disk, pass --synthetic to train on generated pairs.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from ncnet_tpu.data.loader import DataLoader
+from ncnet_tpu.data.pairs import ImagePairDataset, SyntheticPairDataset
+from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+from ncnet_tpu.train.checkpoint import load_checkpoint
+from ncnet_tpu.train.loop import train
+
+
+def main():
+    p = argparse.ArgumentParser(description="ncnet_tpu training")
+    p.add_argument("--dataset_image_path", type=str, default="datasets/pf-pascal")
+    p.add_argument("--dataset_csv_path", type=str,
+                   default="datasets/pf-pascal/image_pairs")
+    p.add_argument("--synthetic", action="store_true",
+                   help="train on synthetic pairs (no dataset needed)")
+    p.add_argument("--image_size", type=int, default=400)
+    p.add_argument("--num_epochs", type=int, default=5)
+    p.add_argument("--batch_size", type=int, default=16)
+    p.add_argument("--lr", type=float, default=5e-4)
+    p.add_argument("--ncons_kernel_sizes", nargs="+", type=int, default=[5, 5, 5])
+    p.add_argument("--ncons_channels", nargs="+", type=int, default=[16, 16, 1])
+    p.add_argument("--fe_arch", type=str, default="resnet101")
+    p.add_argument("--train_fe", action="store_true")
+    p.add_argument("--checkpoint", type=str, default="",
+                   help="resume/initialize from a checkpoint")
+    p.add_argument("--result_model_dir", type=str, default="trained_models")
+    p.add_argument("--result_model_fn", type=str, default="ncnet_tpu.msgpack")
+    p.add_argument("--num_workers", type=int, default=4)
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--bf16", action="store_true", help="bfloat16 compute path")
+    p.add_argument("--conv4d_impl", type=str, default="scan",
+                   choices=["xla", "taps", "scan"])
+    args = p.parse_args()
+
+    start_epoch, opt_state, best_val = 0, None, None
+    if args.checkpoint:
+        ck = load_checkpoint(args.checkpoint)
+        config, params = ck.config, ck.params
+        start_epoch = ck.epoch
+        opt_state = ck.opt_state  # raw state dict; train() restores into shape
+        best_val = ck.best_val_loss
+        print(f"resuming from {args.checkpoint} at epoch {start_epoch}")
+        print(f"  config: {config}")
+    else:
+        config = ImMatchNetConfig(
+            feature_extraction_cnn=args.fe_arch,
+            ncons_kernel_sizes=tuple(args.ncons_kernel_sizes),
+            ncons_channels=tuple(args.ncons_channels),
+            half_precision=args.bf16,
+            conv4d_impl=args.conv4d_impl,
+            nc_remat=True,
+        )
+        params = init_immatchnet(jax.random.PRNGKey(args.seed), config)
+
+    size = (args.image_size, args.image_size)
+    if args.synthetic:
+        train_ds = SyntheticPairDataset(n=256, output_size=size, seed=args.seed)
+        val_ds = SyntheticPairDataset(n=32, output_size=size, seed=args.seed + 1)
+    else:
+        train_ds = ImagePairDataset(
+            os.path.join(args.dataset_csv_path, "train_pairs.csv"),
+            args.dataset_image_path, output_size=size, seed=args.seed,
+        )
+        val_ds = ImagePairDataset(
+            os.path.join(args.dataset_csv_path, "val_pairs.csv"),
+            args.dataset_image_path, output_size=size, seed=args.seed,
+        )
+    train_loader = DataLoader(
+        train_ds, args.batch_size, shuffle=True, seed=args.seed,
+        num_workers=args.num_workers, drop_last=True,
+    )
+    val_loader = DataLoader(
+        val_ds, args.batch_size, shuffle=False,
+        num_workers=args.num_workers, drop_last=True,
+    )
+
+    train(
+        config,
+        params,
+        train_loader,
+        val_loader,
+        num_epochs=args.num_epochs,
+        learning_rate=args.lr,
+        train_fe=args.train_fe,
+        checkpoint_dir=args.result_model_dir,
+        checkpoint_name=args.result_model_fn,
+        start_epoch=start_epoch,
+        opt_state=opt_state,
+        initial_best_val=best_val,
+    )
+
+
+if __name__ == "__main__":
+    main()

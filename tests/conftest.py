@@ -1,0 +1,16 @@
+"""Test environment: 8 virtual CPU devices (standard way to test
+pjit/shard_map sharding without a TPU pod — SURVEY.md §4)."""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The env var JAX_PLATFORMS is ignored when a TPU plugin is present in this
+# image; the config update reliably forces the CPU backend for tests.
+jax.config.update("jax_platforms", "cpu")

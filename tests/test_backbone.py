@@ -1,0 +1,87 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ncnet_tpu.models import resnet, vgg
+from ncnet_tpu.models.resnet import _bn_apply, _conv, _max_pool_3x3_s2
+
+
+def test_bn_matches_torch_eval_mode():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    rng = np.random.RandomState(0)
+    c = 8
+    x = rng.randn(2, 5, 5, c).astype(np.float32)
+    p = {
+        "scale": rng.rand(c).astype(np.float32) + 0.5,
+        "offset": rng.randn(c).astype(np.float32),
+        "mean": rng.randn(c).astype(np.float32),
+        "var": rng.rand(c).astype(np.float32) + 0.1,
+    }
+    got = np.asarray(_bn_apply({k: jnp.asarray(v) for k, v in p.items()}, jnp.asarray(x)))
+    want = F.batch_norm(
+        torch.from_numpy(x.transpose(0, 3, 1, 2)),
+        torch.from_numpy(p["mean"]),
+        torch.from_numpy(p["var"]),
+        torch.from_numpy(p["scale"]),
+        torch.from_numpy(p["offset"]),
+        training=False,
+        eps=1e-5,
+    ).numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "hw,stride,ksize,pad",
+    [(10, 1, 3, 1), (10, 2, 3, 1), (11, 2, 3, 1), (10, 2, 1, 0), (11, 2, 1, 0), (14, 2, 7, 3)],
+)
+def test_conv_padding_matches_torch(hw, stride, ksize, pad):
+    """Stride/padding parity with torch — the sample-position alignment that
+    SURVEY.md §7.3 flags as the backbone-parity hazard."""
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    rng = np.random.RandomState(1)
+    cin, cout = 3, 4
+    x = rng.randn(1, hw, hw, cin).astype(np.float32)
+    w = rng.randn(ksize, ksize, cin, cout).astype(np.float32)
+    padding = ((pad, pad), (pad, pad)) if pad else "SAME" if stride == 1 and ksize > 1 else ((0, 0), (0, 0))
+    got = np.asarray(_conv(jnp.asarray(x), jnp.asarray(w), stride=stride, padding=padding))
+    want = F.conv2d(
+        torch.from_numpy(x.transpose(0, 3, 1, 2)),
+        torch.from_numpy(w.transpose(3, 2, 0, 1)),
+        stride=stride,
+        padding=pad,
+    ).numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_maxpool_matches_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    rng = np.random.RandomState(2)
+    for hw in (10, 11):
+        x = rng.randn(1, hw, hw, 4).astype(np.float32)
+        got = np.asarray(_max_pool_3x3_s2(jnp.asarray(x)))
+        want = F.max_pool2d(
+            torch.from_numpy(x.transpose(0, 3, 1, 2)), 3, stride=2, padding=1
+        ).numpy().transpose(0, 2, 3, 1)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_resnet101_trunk_shape_and_stride():
+    params = resnet.init_resnet101_trunk(jax.random.PRNGKey(0))
+    x = jnp.zeros((1, 64, 64, 3))
+    feats = resnet.resnet101_trunk_apply(params, x)
+    assert feats.shape == (1, 4, 4, 1024)
+    # 400x400 PF-Pascal config -> 25x25 grid (SURVEY.md §3.1)
+    assert len(params["layer3"]) == 23
+
+
+def test_vgg16_trunk_shape():
+    params = vgg.init_vgg16_trunk(jax.random.PRNGKey(0))
+    feats = vgg.vgg16_trunk_apply(params, jnp.zeros((1, 64, 64, 3)))
+    assert feats.shape == (1, 4, 4, 512)

@@ -1,0 +1,86 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ncnet_tpu.ops.conv4d import conv4d
+
+
+def conv4d_bruteforce(x, w, bias=None):
+    """Direct shift-and-multiply 4D SAME convolution oracle."""
+    ki, kj, kk, kl, cin, cout = w.shape
+    b, di, dj, dk, dl, _ = x.shape
+    pads = [(k // 2, k // 2) for k in (ki, kj, kk, kl)]
+    xp = np.pad(x, [(0, 0)] + pads + [(0, 0)])
+    out = np.zeros((b, di, dj, dk, dl, cout), dtype=np.float64)
+    for a in range(ki):
+        for bb in range(kj):
+            for c in range(kk):
+                for d in range(kl):
+                    patch = xp[:, a : a + di, bb : bb + dj, c : c + dk, d : d + dl, :]
+                    out += np.einsum("bijklc,co->bijklo", patch, w[a, bb, c, d])
+    if bias is not None:
+        out += bias
+    return out
+
+
+@pytest.mark.parametrize("impl", ["xla", "taps"])
+@pytest.mark.parametrize("ksize,cin,cout", [(3, 1, 2), (5, 2, 1)])
+def test_conv4d_matches_bruteforce(impl, ksize, cin, cout):
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 5, 4, 6, cin).astype(np.float32)
+    w = rng.randn(ksize, ksize, ksize, ksize, cin, cout).astype(np.float32)
+    bias = rng.randn(cout).astype(np.float32)
+    got = conv4d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(bias), impl=impl)
+    want = conv4d_bruteforce(x, w, bias)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
+
+
+def test_conv4d_impls_agree_with_grad():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(1, 4, 4, 4, 4, 2).astype(np.float32))
+    w = jnp.asarray(rng.randn(3, 3, 3, 3, 2, 2).astype(np.float32))
+    b = jnp.asarray(rng.randn(2).astype(np.float32))
+
+    f_xla = lambda w_: jnp.sum(jnp.sin(conv4d(x, w_, b, impl="xla")))
+    f_taps = lambda w_: jnp.sum(jnp.sin(conv4d(x, w_, b, impl="taps")))
+    np.testing.assert_allclose(f_xla(w), f_taps(w), rtol=1e-5)
+    g_xla = jax.grad(f_xla)(w)
+    g_taps = jax.grad(f_taps)(w)
+    np.testing.assert_allclose(
+        np.asarray(g_xla), np.asarray(g_taps), rtol=1e-3, atol=1e-4
+    )
+
+
+def test_conv4d_matches_torch_conv3d_decomposition():
+    """Cross-check against a torch conv3d tap decomposition (the reference's
+    formulation, lib/conv4d.py:39-48: bias only on the center tap)."""
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    rng = np.random.RandomState(2)
+    ksize, cin, cout = 3, 2, 3
+    x = rng.randn(1, 5, 4, 4, 5, cin).astype(np.float32)
+    w = rng.randn(ksize, ksize, ksize, ksize, cin, cout).astype(np.float32)
+    bias = rng.randn(cout).astype(np.float32)
+
+    got = np.asarray(conv4d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(bias)))
+
+    # torch conv3d expects [b, c, D, H, W]; tap over the first kernel dim.
+    xt = torch.from_numpy(x.transpose(0, 5, 1, 2, 3, 4))  # [b, c, i, j, k, l]
+    wt = torch.from_numpy(w.transpose(5, 4, 0, 1, 2, 3))  # [cout, cin, ki, kj, kk, kl]
+    bt = torch.from_numpy(bias)
+    pad = ksize // 2
+    b_, c_, i_, j_, k_, l_ = xt.shape
+    xpad = torch.nn.functional.pad(xt, (0, 0, 0, 0, 0, 0, pad, pad))
+    out = torch.zeros(b_, cout, i_, j_, k_, l_)
+    for i in range(i_):
+        for p in range(ksize):
+            out[:, :, i] += F.conv3d(
+                xpad[:, :, i + p],
+                wt[:, :, p],
+                bias=bt if p == pad else None,
+                padding=pad,
+            )
+    want = out.numpy().transpose(0, 2, 3, 4, 5, 1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)

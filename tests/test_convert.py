@@ -1,0 +1,133 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+from ncnet_tpu.models.neigh_consensus import neigh_consensus_apply
+from ncnet_tpu.models.resnet import RESNET101_STAGES, EXPANSION
+from ncnet_tpu.utils import convert_torch
+
+
+def _fake_resnet_state_dict(prefix="FeatureExtraction.model."):
+    """Synthetic state dict with torchvision Sequential-index naming and
+    correct shapes (what the reference checkpoints contain)."""
+    g = torch.Generator().manual_seed(0)
+    sd = {}
+
+    def conv(name, cout, cin, k):
+        sd[name + ".weight"] = torch.randn(cout, cin, k, k, generator=g)
+
+    def bn(name, c):
+        sd[name + ".weight"] = torch.randn(c, generator=g)
+        sd[name + ".bias"] = torch.randn(c, generator=g)
+        sd[name + ".running_mean"] = torch.randn(c, generator=g)
+        sd[name + ".running_var"] = torch.rand(c, generator=g) + 0.5
+        sd[name + ".num_batches_tracked"] = torch.tensor(0)
+
+    conv(prefix + "0", 64, 3, 7)
+    bn(prefix + "1", 64)
+    cin = 64
+    for si, (n_blocks, planes, _) in enumerate(RESNET101_STAGES):
+        seq_idx = 4 + si
+        for bi in range(n_blocks):
+            p = f"{prefix}{seq_idx}.{bi}."
+            conv(p + "conv1", planes, cin, 1)
+            bn(p + "bn1", planes)
+            conv(p + "conv2", planes, planes, 3)
+            bn(p + "bn2", planes)
+            conv(p + "conv3", planes * EXPANSION, planes, 1)
+            bn(p + "bn3", planes * EXPANSION)
+            if bi == 0:
+                conv(p + "downsample.0", planes * EXPANSION, cin, 1)
+                bn(p + "downsample.1", planes * EXPANSION)
+            cin = planes * EXPANSION
+    return sd
+
+
+def test_resnet_conversion_structure_matches_init():
+    sd = _fake_resnet_state_dict()
+    converted = convert_torch.convert_resnet101_trunk(sd)
+    ref = init_immatchnet(
+        jax.random.PRNGKey(0), ImMatchNetConfig(ncons_kernel_sizes=(3,), ncons_channels=(1,))
+    )["feature_extraction"]
+    ref_flat, ref_tree = jax.tree.flatten(ref)
+    got_flat, got_tree = jax.tree.flatten(converted)
+    assert ref_tree == got_tree
+    for a, b in zip(ref_flat, got_flat):
+        assert np.shape(a) == np.shape(b)
+
+
+def test_conv4d_weight_conversion_semantics():
+    """A reference-style pre-permuted Conv4d weight must convert to a kernel
+    that makes our conv4d agree with torch's conv3d tap decomposition."""
+    import torch.nn.functional as F
+
+    g = torch.Generator().manual_seed(1)
+    k, cin, cout = 3, 1, 2
+    w_native = torch.randn(cout, cin, k, k, k, k, generator=g)  # torch layout
+    bias = torch.randn(cout, generator=g)
+    # the reference stores weights permuted: (2,0,1,3,4,5) (lib/conv4d.py:72-77)
+    w_stored = w_native.permute(2, 0, 1, 3, 4, 5).contiguous()
+    sd = {"NeighConsensus.conv.0.weight": w_stored, "NeighConsensus.conv.0.bias": bias}
+    params = convert_torch.convert_neigh_consensus(sd)
+
+    x = torch.randn(1, cin, 4, 4, 4, 4, generator=g)
+    pad = k // 2
+    xpad = F.pad(x, (0, 0, 0, 0, 0, 0, pad, pad))
+    want = torch.zeros(1, cout, 4, 4, 4, 4)
+    for i in range(4):
+        for p in range(k):
+            want[:, :, i] += F.conv3d(
+                xpad[:, :, i + p],
+                w_native[:, :, p],
+                bias=bias if p == pad else None,
+                padding=pad,
+            )
+    want_np = want.numpy().transpose(0, 2, 3, 4, 5, 1)[..., :]
+
+    from ncnet_tpu.ops.conv4d import conv4d
+
+    x_jax = jnp.asarray(x.numpy().transpose(0, 2, 3, 4, 5, 1))
+    got = conv4d(x_jax, jnp.asarray(params[0]["kernel"]), jnp.asarray(params[0]["bias"]))
+    np.testing.assert_allclose(np.asarray(got), want_np, rtol=1e-4, atol=1e-4)
+
+
+def test_full_checkpoint_conversion(tmp_path):
+    """Round-trip a reference-schema .pth.tar through convert_checkpoint."""
+    import argparse
+
+    sd = _fake_resnet_state_dict()
+    g = torch.Generator().manual_seed(2)
+    # NeighConsensus.conv indices 0, 2 (ReLUs at odd indices), kernels 3-3, ch 16-1
+    w0 = torch.randn(16, 1, 3, 3, 3, 3, generator=g).permute(2, 0, 1, 3, 4, 5)
+    w1 = torch.randn(1, 16, 3, 3, 3, 3, generator=g).permute(2, 0, 1, 3, 4, 5)
+    sd["NeighConsensus.conv.0.weight"] = w0.contiguous()
+    sd["NeighConsensus.conv.0.bias"] = torch.randn(16, generator=g)
+    sd["NeighConsensus.conv.2.weight"] = w1.contiguous()
+    sd["NeighConsensus.conv.2.bias"] = torch.randn(1, generator=g)
+
+    args = argparse.Namespace(
+        ncons_kernel_sizes=[3, 3], ncons_channels=[16, 1], fe_arch="resnet101"
+    )
+    ckpt = {"state_dict": sd, "args": args, "epoch": 5}
+    path = str(tmp_path / "ref.pth.tar")
+    torch.save(ckpt, path)
+
+    config, params = convert_torch.convert_checkpoint(path)
+    assert config.ncons_kernel_sizes == (3, 3)
+    assert config.ncons_channels == (16, 1)
+    assert params["neigh_consensus"][0]["kernel"].shape == (3, 3, 3, 3, 1, 16)
+    assert params["neigh_consensus"][1]["kernel"].shape == (3, 3, 3, 3, 16, 1)
+    # converted params must run through the NC stack
+    corr = jnp.asarray(np.random.RandomState(0).randn(1, 4, 4, 4, 4).astype(np.float32))
+    out = neigh_consensus_apply(
+        [
+            {k: jnp.asarray(v) for k, v in layer.items()}
+            for layer in params["neigh_consensus"]
+        ],
+        corr,
+    )
+    assert out.shape == corr.shape

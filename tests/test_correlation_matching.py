@@ -1,0 +1,102 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ncnet_tpu.ops.correlation import correlation_4d, correlation_maxpool4d
+from ncnet_tpu.ops.matching import maxpool4d, mutual_matching
+from ncnet_tpu.ops.norm import feature_l2norm
+
+
+def test_correlation_4d_is_all_pairs_dot():
+    rng = np.random.RandomState(0)
+    fa = rng.randn(2, 3, 4, 8).astype(np.float32)
+    fb = rng.randn(2, 5, 6, 8).astype(np.float32)
+    got = np.asarray(correlation_4d(jnp.asarray(fa), jnp.asarray(fb)))
+    want = np.einsum("bijc,bklc->bijkl", fa, fb)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_correlation_4d_normalized_branch():
+    rng = np.random.RandomState(1)
+    fa = rng.randn(1, 3, 3, 4).astype(np.float32)
+    fb = rng.randn(1, 3, 3, 4).astype(np.float32)
+    got = np.asarray(
+        correlation_4d(jnp.asarray(fa), jnp.asarray(fb), normalization=True)
+    )
+    raw = np.maximum(np.einsum("bijc,bklc->bijkl", fa, fb), 0)
+    flat = raw.reshape(1, 3, 3, 9)
+    want = (flat / np.sqrt((flat**2).sum(-1, keepdims=True) + 1e-6)).reshape(raw.shape)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_feature_l2norm():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 4, 5).astype(np.float32)
+    got = np.asarray(feature_l2norm(jnp.asarray(x)))
+    want = x / np.sqrt((x**2).sum(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_mutual_matching_formula_and_symmetry():
+    rng = np.random.RandomState(3)
+    corr = rng.rand(2, 3, 4, 5, 6).astype(np.float32)
+    got = np.asarray(mutual_matching(jnp.asarray(corr)))
+    max_a = corr.max(axis=(1, 2), keepdims=True)
+    max_b = corr.max(axis=(3, 4), keepdims=True)
+    want = corr * ((corr / (max_b + 1e-5)) * (corr / (max_a + 1e-5)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    # MM(x^T) == MM(x)^T where ^T swaps the A/B index pairs
+    corr_t = corr.transpose(0, 3, 4, 1, 2)
+    got_t = np.asarray(mutual_matching(jnp.asarray(corr_t)))
+    np.testing.assert_allclose(got_t, got.transpose(0, 3, 4, 1, 2), rtol=1e-5)
+
+
+def maxpool4d_bruteforce(corr, k):
+    b, d1, d2, d3, d4 = corr.shape
+    pooled = np.zeros((b, d1 // k, d2 // k, d3 // k, d4 // k), corr.dtype)
+    offs = [np.zeros_like(pooled, dtype=np.int32) for _ in range(4)]
+    for bi in range(b):
+        for i in range(d1 // k):
+            for j in range(d2 // k):
+                for p in range(d3 // k):
+                    for q in range(d4 // k):
+                        block = corr[
+                            bi,
+                            i * k : (i + 1) * k,
+                            j * k : (j + 1) * k,
+                            p * k : (p + 1) * k,
+                            q * k : (q + 1) * k,
+                        ]
+                        flat = block.reshape(-1)
+                        m = int(np.argmax(flat))
+                        pooled[bi, i, j, p, q] = flat[m]
+                        o = np.unravel_index(m, (k, k, k, k))
+                        for a in range(4):
+                            offs[a][bi, i, j, p, q] = o[a]
+    return pooled, tuple(offs)
+
+
+def test_maxpool4d_matches_bruteforce():
+    rng = np.random.RandomState(4)
+    corr = rng.randn(1, 4, 4, 6, 6).astype(np.float32)
+    pooled, deltas = maxpool4d(jnp.asarray(corr), 2)
+    want_pooled, want_deltas = maxpool4d_bruteforce(corr, 2)
+    np.testing.assert_allclose(np.asarray(pooled), want_pooled, rtol=1e-6)
+    for got_d, want_d in zip(deltas, want_deltas):
+        np.testing.assert_array_equal(np.asarray(got_d), want_d)
+
+
+@pytest.mark.parametrize("k", [2, 3])
+def test_fused_correlation_maxpool_equals_unfused(k):
+    rng = np.random.RandomState(5)
+    fa = rng.randn(2, 2 * k, 3 * k, 7).astype(np.float32)
+    fb = rng.randn(2, 3 * k, 2 * k, 7).astype(np.float32)
+    fused, fused_d = correlation_maxpool4d(jnp.asarray(fa), jnp.asarray(fb), k)
+    full = correlation_4d(jnp.asarray(fa), jnp.asarray(fb))
+    unfused, unfused_d = maxpool4d(full, k)
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(unfused), rtol=1e-5, atol=1e-6
+    )
+    for a, b_ in zip(fused_d, unfused_d):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))

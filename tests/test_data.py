@@ -1,0 +1,138 @@
+import os
+
+import numpy as np
+import pytest
+
+from ncnet_tpu.data.images import (
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    normalize_image_np,
+    resize_bilinear_np,
+)
+from ncnet_tpu.data.loader import DataLoader, collate, shard_indices
+from ncnet_tpu.data.pairs import ImagePairDataset, PFPascalDataset, SyntheticPairDataset
+
+
+def test_resize_matches_torch_align_corners():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    rng = np.random.RandomState(0)
+    img = rng.rand(11, 17, 3).astype(np.float32) * 255
+    got = resize_bilinear_np(img, 25, 40)
+    want = F.interpolate(
+        torch.from_numpy(img.transpose(2, 0, 1))[None],
+        size=(25, 40),
+        mode="bilinear",
+        align_corners=True,
+    )[0].numpy().transpose(1, 2, 0)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+
+
+def test_resize_matches_jax_op():
+    import jax.numpy as jnp
+
+    from ncnet_tpu.ops.image import resize_bilinear_align_corners
+
+    rng = np.random.RandomState(1)
+    img = rng.rand(9, 13, 3).astype(np.float32)
+    got_np = resize_bilinear_np(img, 20, 30)
+    got_jax = np.asarray(resize_bilinear_align_corners(jnp.asarray(img), 20, 30))
+    np.testing.assert_allclose(got_np, got_jax, rtol=1e-5, atol=1e-5)
+
+
+def test_normalize():
+    img = np.full((4, 4, 3), 255.0, np.float32)
+    out = normalize_image_np(img)
+    want = np.broadcast_to((1.0 - IMAGENET_MEAN) / IMAGENET_STD, out.shape)
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def _write_png(path, arr):
+    from PIL import Image
+
+    Image.fromarray(arr.astype(np.uint8)).save(path)
+
+
+@pytest.fixture
+def fake_pf_dataset(tmp_path):
+    rng = np.random.RandomState(0)
+    img_dir = tmp_path / "JPEGImages"
+    img_dir.mkdir()
+    names = []
+    for i in range(4):
+        name = f"JPEGImages/im{i}.png"
+        _write_png(tmp_path / name, rng.randint(0, 255, (30 + i, 40 + i, 3)))
+        names.append(name)
+    # train/val schema
+    train_csv = tmp_path / "train_pairs.csv"
+    with open(train_csv, "w") as f:
+        f.write("source_image,target_image,class,flip\n")
+        f.write(f"{names[0]},{names[1]},1,0\n")
+        f.write(f"{names[2]},{names[3]},2,1\n")
+    # test schema with keypoints
+    test_csv = tmp_path / "test_pairs.csv"
+    with open(test_csv, "w") as f:
+        f.write("source_image,target_image,class,XA,YA,XB,YB\n")
+        f.write(f"{names[0]},{names[1]},1,10;20;30,5;15;25,12;22;32,6;16;26\n")
+    return tmp_path, train_csv, test_csv
+
+
+def test_image_pair_dataset(fake_pf_dataset):
+    root, train_csv, _ = fake_pf_dataset
+    ds = ImagePairDataset(str(train_csv), str(root), output_size=(32, 32))
+    assert len(ds) == 2
+    s = ds[0]
+    assert s["source_image"].shape == (32, 32, 3)
+    assert s["target_image"].shape == (32, 32, 3)
+    # flip row: flipping source then resizing == resize then flip (allclose)
+    s2 = ds[1]
+    ds_noflip = ImagePairDataset(str(train_csv), str(root), output_size=(32, 32))
+    ds_noflip.rows[1][3] = "0"
+    s2_nf = ds_noflip[1]
+    np.testing.assert_allclose(
+        s2["source_image"], s2_nf["source_image"][:, ::-1], atol=1e-4
+    )
+
+
+def test_pf_pascal_dataset_scnet_procedure(fake_pf_dataset):
+    root, _, test_csv = fake_pf_dataset
+    ds = PFPascalDataset(str(test_csv), str(root), output_size=(32, 32),
+                         pck_procedure="scnet")
+    s = ds[0]
+    # original image 0 is 30x40; scnet rescales points to a virtual 224x224
+    assert float(s["L_pck"][0]) == 224.0
+    np.testing.assert_allclose(s["source_im_size"][:2], [224, 224])
+    np.testing.assert_allclose(s["source_points"][0, 0], 10 * 224 / 40, rtol=1e-5)
+    np.testing.assert_allclose(s["source_points"][1, 0], 5 * 224 / 30, rtol=1e-5)
+    # -1 padding beyond the 3 annotated points
+    assert np.all(s["source_points"][:, 3:] == -1)
+
+
+def test_pf_procedure_bbox_lpck(fake_pf_dataset):
+    root, _, test_csv = fake_pf_dataset
+    ds = PFPascalDataset(str(test_csv), str(root), output_size=(32, 32),
+                         pck_procedure="pf")
+    s = ds[0]
+    # max bbox side of source points: x range 20, y range 20
+    assert float(s["L_pck"][0]) == 20.0
+
+
+def test_loader_deterministic_and_worker_invariant():
+    ds = SyntheticPairDataset(n=12, output_size=(16, 16))
+    batches1 = [b for b in DataLoader(ds, 4, shuffle=True, seed=3, num_workers=1)]
+    batches4 = [b for b in DataLoader(ds, 4, shuffle=True, seed=3, num_workers=4)]
+    assert len(batches1) == len(batches4) == 3
+    for b1, b4 in zip(batches1, batches4):
+        np.testing.assert_array_equal(b1["source_image"], b4["source_image"])
+
+
+def test_loader_sharding():
+    idx0 = shard_indices(10, 0, 2)
+    idx1 = shard_indices(10, 1, 2)
+    assert sorted(np.concatenate([idx0, idx1]).tolist()) == list(range(10))
+
+
+def test_collate():
+    out = collate([{"a": np.zeros((2, 2), np.float32)}, {"a": np.ones((2, 2), np.float32)}])
+    assert out["a"].shape == (2, 2, 2)

@@ -1,0 +1,147 @@
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.eval.inloc import (
+    match_pair,
+    make_match_fn,
+    n_match_slots,
+    quantized_resize_shape,
+    recenter,
+)
+from ncnet_tpu.eval.pf_pascal import evaluate, make_pck_step
+from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+
+TINY = ImMatchNetConfig(ncons_kernel_sizes=(3,), ncons_channels=(1,))
+
+
+def test_quantized_resize_shape_reference_formula():
+    # reference formula (eval_inloc.py:84-89) on a 1600x1200 image at
+    # image_size=3200, k=2: ratio 0.5 -> 3200x2400 -> quantized to 32-mult.
+    h, w = quantized_resize_shape(1600, 1200, 3200, 2)
+    assert h % 32 == 0 and w % 32 == 0
+    s = 0.0625
+    want_h = int(np.floor(1600 / (1600 / 3200) * s / 2) / s * 2)
+    want_w = int(np.floor(1200 / (1600 / 3200) * s / 2) / s * 2)
+    assert (h, w) == (want_h, want_w)
+    # k=1: plain aspect-preserving resize
+    assert quantized_resize_shape(1600, 1200, 3200, 1) == (3200, 2400)
+
+
+def test_n_match_slots():
+    # reference N formula (eval_inloc.py:116-118)
+    n = n_match_slots(3200, 2, both_directions=True)
+    g = 3200 * 0.0625 / 2
+    assert n == 2 * int(g * np.floor(g * 0.75))
+
+
+def test_recenter():
+    # grid of 4 cells: corner 0 -> cell center 1/8
+    assert np.isclose(recenter(np.float32(0.0), 4), 0.125)
+    assert np.isclose(recenter(np.float32(1.0), 4), 1 - 0.125)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return init_immatchnet(jax.random.PRNGKey(0), TINY)
+
+
+def test_match_pair_rectangular(tiny):
+    rng = np.random.RandomState(0)
+    src = jnp.asarray(rng.randn(1, 64, 96, 3).astype(np.float32))
+    tgt = jnp.asarray(rng.randn(1, 96, 64, 3).astype(np.float32))
+    fn = jax.jit(make_match_fn(TINY))
+    xa, ya, xb, yb, score = match_pair(fn, tiny, src, tgt, k_size=0)
+    # both directions, deduped: between max(grid) and sum of both grids
+    assert 24 <= len(xa) <= 48
+    for v in (xa, ya, xb, yb):
+        assert np.all((v >= 0) & (v <= 1))
+    # descending score order after sort+dedup is not guaranteed post-unique;
+    # but scores must be valid probabilities after softmax
+    assert np.all(score >= 0) and np.all(score <= 1)
+
+
+def test_match_pair_relocalization(tiny):
+    cfg = TINY.replace(relocalization_k_size=2)
+    rng = np.random.RandomState(1)
+    src = jnp.asarray(rng.randn(1, 128, 128, 3).astype(np.float32))
+    tgt = jnp.asarray(rng.randn(1, 128, 128, 3).astype(np.float32))
+    fn = jax.jit(make_match_fn(cfg))
+    xa, ya, xb, yb, score = match_pair(fn, tiny, src, tgt, k_size=2)
+    assert np.all((xa >= 0) & (xa <= 1))
+
+
+def test_pck_eval_pipeline(tiny):
+    """Identity pairs + bypassed NC should give near-perfect PCK; with the
+    random NC head the pipeline must still run end-to-end."""
+    rng = np.random.RandomState(0)
+    img = rng.rand(1, 64, 64, 3).astype(np.float32)
+    batch = {
+        "source_image": jnp.asarray(img),
+        "target_image": jnp.asarray(img),
+        "source_points": jnp.asarray([[[10, 40, -1], [12, 30, -1]]], jnp.float32),
+        "target_points": jnp.asarray([[[10, 40, -1], [12, 30, -1]]], jnp.float32),
+        "source_im_size": jnp.asarray([[64, 64, 3]], jnp.float32),
+        "target_im_size": jnp.asarray([[64, 64, 3]], jnp.float32),
+        "L_pck": jnp.asarray([[224.0]], jnp.float32),
+    }
+    step = make_pck_step(TINY)
+    out = np.asarray(step(tiny, batch))
+    assert out.shape == (1,)
+    assert 0.0 <= float(out[0]) <= 1.0
+
+
+def test_dump_matches_contract(tiny, tmp_path):
+    """End-to-end .mat dump with a synthetic shortlist: the [1,Npanos,N,5]
+    contract consumed by lib_matlab (SURVEY.md §1 L6)."""
+    from scipy.io import loadmat, savemat
+
+    from ncnet_tpu.eval.inloc import dump_matches
+
+    rng = np.random.RandomState(0)
+    qdir = tmp_path / "query"
+    pdir = tmp_path / "pano"
+    qdir.mkdir()
+    pdir.mkdir()
+    from PIL import Image
+
+    for d, name in ((qdir, "q0.png"), (pdir, "p0.png"), (pdir, "p1.png")):
+        Image.fromarray(
+            rng.randint(0, 255, (80, 60, 3), np.uint8)
+        ).save(d / name)
+
+    # shortlist schema: a MATLAB struct array; ImgList[0, q] has the query
+    # filename at field 0 and the pano shortlist at field 1
+    dt = np.dtype([("queryname", object), ("topN", object)])
+    entry = np.zeros((1, 1), dt)
+    entry[0, 0] = (
+        np.array(["q0.png"], object),
+        np.array([["p0.png"], ["p1.png"]], object),
+    )
+    shortlist = tmp_path / "shortlist.mat"
+    savemat(shortlist, {"ImgList": entry})
+
+    cfg = TINY.replace(relocalization_k_size=2)
+    out_dir = tmp_path / "matches"
+    dump_matches(
+        tiny,
+        cfg,
+        shortlist_path=str(shortlist),
+        query_path=str(qdir),
+        pano_path=str(pdir),
+        output_dir=str(out_dir),
+        image_size=128,
+        n_queries=1,
+        n_panos=2,
+        verbose=False,
+    )
+    out = loadmat(out_dir / "1.mat")
+    n_slots = n_match_slots(128, 2, True)
+    assert out["matches"].shape == (1, 2, n_slots, 5)
+    assert np.all(out["matches"][..., :4] >= 0)
+    assert np.all(out["matches"][..., :4] <= 1)
+    # at least some slots filled for both panos
+    assert (np.abs(out["matches"][0, 0]).sum() > 0)
+    assert (np.abs(out["matches"][0, 1]).sum() > 0)

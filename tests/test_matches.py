@@ -1,0 +1,153 @@
+import jax.numpy as jnp
+import numpy as np
+
+from ncnet_tpu.ops.coords import (
+    normalize_axis,
+    points_to_pixel_coords,
+    points_to_unit_coords,
+    unnormalize_axis,
+)
+from ncnet_tpu.ops.matches import (
+    bilinear_point_transfer,
+    corr_to_matches,
+    nearest_point_transfer,
+)
+from ncnet_tpu.ops.metrics import pck
+
+
+def planted_corr(b, fs, links):
+    """corr with a strong peak corr[iA,jA,iB,jB] for each planted link."""
+    corr = np.zeros((b, fs, fs, fs, fs), np.float32)
+    for bi, ia, ja, ib, jb in links:
+        corr[bi, ia, ja, ib, jb] = 10.0
+    return corr
+
+
+def test_corr_to_matches_default_direction_planted():
+    fs = 4
+    corr = planted_corr(1, fs, [(0, 1, 2, 3, 0)])
+    xa, ya, xb, yb, score = corr_to_matches(jnp.asarray(corr), do_softmax=True)
+    lin = np.linspace(-1, 1, fs)
+    # B cell (3, 0) must match A cell (1, 2)
+    n = 3 * fs + 0
+    assert np.isclose(xa[0, n], lin[2])
+    assert np.isclose(ya[0, n], lin[1])
+    assert np.isclose(xb[0, n], lin[0])
+    assert np.isclose(yb[0, n], lin[3])
+    # softmax over 16 A-cells with one logit at 10
+    want = np.exp(10.0) / (np.exp(10.0) + fs * fs - 1)
+    assert np.isclose(score[0, n], want, rtol=1e-5)
+    # B grid coords enumerate the meshgrid row-major
+    np.testing.assert_allclose(np.asarray(xb[0]), np.tile(lin, fs), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(yb[0]), np.repeat(lin, fs), rtol=1e-6)
+
+
+def test_corr_to_matches_inverted_direction():
+    fs = 4
+    corr = planted_corr(1, fs, [(0, 2, 1, 0, 3)])
+    xa, ya, xb, yb, score = corr_to_matches(
+        jnp.asarray(corr), invert_matching_direction=True
+    )
+    lin = np.linspace(-1, 1, fs)
+    n = 2 * fs + 1  # A cell (2, 1)
+    assert np.isclose(xb[0, n], lin[3])
+    assert np.isclose(yb[0, n], lin[0])
+    assert np.isclose(xa[0, n], lin[1])
+    assert np.isclose(ya[0, n], lin[2])
+
+
+def test_corr_to_matches_positive_scale_and_batch():
+    fs = 3
+    corr = planted_corr(2, fs, [(0, 0, 0, 0, 0), (1, 2, 2, 1, 1)])
+    xa, ya, xb, yb, score = corr_to_matches(jnp.asarray(corr), scale="positive")
+    lin = np.linspace(0, 1, fs)
+    assert np.isclose(xa[0, 0], lin[0]) and np.isclose(ya[0, 0], lin[0])
+    n = 1 * fs + 1
+    assert np.isclose(xa[1, n], lin[2]) and np.isclose(ya[1, n], lin[2])
+
+
+def test_corr_to_matches_relocalization_deltas():
+    fs, k = 3, 2
+    corr = planted_corr(1, fs, [(0, 1, 1, 2, 2)])
+    deltas = tuple(
+        jnp.asarray(np.full((1, fs, fs, fs, fs), v, np.int32)) for v in (1, 0, 1, 1)
+    )
+    xa, ya, xb, yb, score = corr_to_matches(
+        jnp.asarray(corr), delta4d=deltas, k_size=k
+    )
+    lin = np.linspace(-1, 1, fs * k)
+    n = 2 * fs + 2
+    # fine indices: iA=1*2+1=3, jA=1*2+0=2, iB=2*2+1=5, jB=2*2+1=5
+    assert np.isclose(ya[0, n], lin[3])
+    assert np.isclose(xa[0, n], lin[2])
+    assert np.isclose(yb[0, n], lin[5])
+    assert np.isclose(xb[0, n], lin[5])
+
+
+def identity_matches(fs, b=1):
+    lin = np.linspace(-1, 1, fs).astype(np.float32)
+    xb = np.tile(lin, fs)[None].repeat(b, 0)
+    yb = np.repeat(lin, fs)[None].repeat(b, 0)
+    return xb.copy(), yb.copy(), xb, yb
+
+
+def test_bilinear_point_transfer_identity():
+    fs = 5
+    xa, ya, xb, yb = identity_matches(fs)
+    pts = np.array([[[-0.3, 0.55, 0.0], [0.2, -0.8, 0.0]]], np.float32)
+    warped = bilinear_point_transfer(
+        tuple(map(jnp.asarray, (xa, ya, xb, yb))), jnp.asarray(pts)
+    )
+    np.testing.assert_allclose(np.asarray(warped), pts, rtol=1e-5, atol=1e-6)
+
+
+def test_bilinear_point_transfer_affine():
+    fs = 5
+    xb, yb, _, _ = identity_matches(fs)
+    xa = 0.5 * xb + 0.1
+    ya = -0.25 * yb
+    pts = np.array([[[-0.4, 0.3], [0.6, -0.2]]], np.float32)
+    warped = np.asarray(
+        bilinear_point_transfer(
+            tuple(map(jnp.asarray, (xa, ya, xb, yb))), jnp.asarray(pts)
+        )
+    )
+    np.testing.assert_allclose(warped[0, 0], 0.5 * pts[0, 0] + 0.1, rtol=1e-5)
+    np.testing.assert_allclose(warped[0, 1], -0.25 * pts[0, 1], rtol=1e-5, atol=1e-6)
+
+
+def test_nearest_point_transfer():
+    fs = 4
+    xa, ya, xb, yb = identity_matches(fs)
+    xa = xa + 0.05
+    pts = np.array([[[-1.0, 0.9], [-1.0, 0.9]]], np.float32)
+    warped = np.asarray(
+        nearest_point_transfer(
+            tuple(map(jnp.asarray, (xa, ya, xb, yb))), jnp.asarray(pts)
+        )
+    )
+    lin = np.linspace(-1, 1, fs)
+    np.testing.assert_allclose(warped[0, 0], [lin[0] + 0.05, lin[3] + 0.05], rtol=1e-5)
+
+
+def test_coord_roundtrip_and_convention():
+    # 1-indexed center convention: pixel (W+1)/2 -> 0
+    assert np.isclose(float(normalize_axis(jnp.asarray(3.0), 5.0)), 0.0)
+    assert np.isclose(float(unnormalize_axis(jnp.asarray(0.0), 5.0)), 3.0)
+    pts = jnp.asarray(np.array([[[1.0, 5.0], [1.0, 3.0]]], np.float32))
+    size = jnp.asarray(np.array([[3.0, 5.0]], np.float32))  # (h, w)
+    unit = points_to_unit_coords(pts, size)
+    back = points_to_pixel_coords(unit, size)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(pts), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(unit[0, 0]), [-1.0, 1.0], atol=1e-6)
+
+
+def test_pck_counts_valid_only():
+    src = np.full((1, 2, 5), -1, np.float32)
+    src[:, :, :3] = [[10, 20, 30], [10, 20, 30]]
+    warped = src.copy()
+    warped[0, 0, 1] += 100.0  # one bad point
+    got = np.asarray(
+        pck(jnp.asarray(src), jnp.asarray(warped), jnp.asarray([100.0]))
+    )
+    np.testing.assert_allclose(got, [2.0 / 3.0], rtol=1e-6)

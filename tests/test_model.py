@@ -1,0 +1,91 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ncnet_tpu.models.immatchnet import (
+    ImMatchNet,
+    ImMatchNetConfig,
+    immatchnet_apply,
+    init_immatchnet,
+)
+
+TINY = ImMatchNetConfig(
+    ncons_kernel_sizes=(3, 3), ncons_channels=(4, 1)
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    params = init_immatchnet(jax.random.PRNGKey(0), TINY)
+    return params
+
+
+def _rand_images(rng, b=1, hw=64):
+    return jnp.asarray(rng.randn(b, hw, hw, 3).astype(np.float32))
+
+
+def test_forward_shape(tiny_model):
+    rng = np.random.RandomState(0)
+    src, tgt = _rand_images(rng), _rand_images(rng)
+    corr = immatchnet_apply(tiny_model, TINY, src, tgt)
+    assert corr.shape == (1, 4, 4, 4, 4)
+    assert corr.dtype == jnp.float32
+
+
+def test_symmetry_swap_images(tiny_model):
+    """With symmetric NeighConsensus, swapping source/target transposes the
+    correlation output (property implied by lib/model.py:144-150)."""
+    rng = np.random.RandomState(1)
+    src, tgt = _rand_images(rng), _rand_images(rng)
+    corr_ab = immatchnet_apply(tiny_model, TINY, src, tgt)
+    corr_ba = immatchnet_apply(tiny_model, TINY, tgt, src)
+    np.testing.assert_allclose(
+        np.asarray(corr_ab),
+        np.asarray(corr_ba).transpose(0, 3, 4, 1, 2),
+        rtol=1e-4,
+        atol=1e-5,
+    )
+
+
+def test_relocalization_output(tiny_model):
+    cfg = TINY.replace(relocalization_k_size=2)
+    rng = np.random.RandomState(2)
+    src, tgt = _rand_images(rng, hw=128), _rand_images(rng, hw=128)
+    corr, delta4d = immatchnet_apply(tiny_model, cfg, src, tgt)
+    assert corr.shape == (1, 4, 4, 4, 4)
+    assert len(delta4d) == 4
+    for d in delta4d:
+        assert d.shape == (1, 4, 4, 4, 4)
+        assert int(jnp.max(d)) <= 1
+
+
+def test_half_precision_runs(tiny_model):
+    cfg = TINY.replace(half_precision=True)
+    rng = np.random.RandomState(3)
+    src, tgt = _rand_images(rng), _rand_images(rng)
+    corr = immatchnet_apply(tiny_model, cfg, src, tgt)
+    assert corr.dtype == jnp.float32
+    ref = immatchnet_apply(tiny_model, TINY, src, tgt)
+    # bf16 path should be close to fp32 in relative terms
+    np.testing.assert_allclose(
+        np.asarray(corr), np.asarray(ref), rtol=0.2, atol=1e-3
+    )
+
+
+def test_wrapper_and_checkpoint_roundtrip(tiny_model, tmp_path):
+    from ncnet_tpu.train.checkpoint import CheckpointData, load_checkpoint, save_checkpoint
+
+    path = str(tmp_path / "ckpt.msgpack")
+    save_checkpoint(path, CheckpointData(config=TINY, params=tiny_model))
+    model = ImMatchNet(checkpoint=path)
+    assert model.config == TINY
+    rng = np.random.RandomState(4)
+    src, tgt = _rand_images(rng), _rand_images(rng)
+    got = model(src, tgt)
+    want = immatchnet_apply(tiny_model, TINY, src, tgt)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6)
+
+    loaded = load_checkpoint(path)
+    chex = pytest.importorskip("chex")
+    chex.assert_trees_all_close(loaded.params, jax.tree.map(np.asarray, tiny_model))
