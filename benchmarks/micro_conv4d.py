@@ -1,0 +1,138 @@
+"""Microbenchmark: conv4d implementations on the real chip, honest timing.
+
+Two platform facts (measured, round 1-2):
+  * ``jax.block_until_ready`` does not block — only a D2H transfer forces
+    execution;
+  * a D2H roundtrip costs ~75-95 ms on the tunneled axon platform, which
+    swamps per-op timings.
+
+So this bench times a CHAIN of N dependent applications inside one jit
+with a single D2H sync, at two values of N, and reports the slope — the
+sync constant and dispatch overheads cancel.
+
+Shapes follow the PF-Pascal training config hot layer (SURVEY.md §3.1):
+corr [16, 25, 25, 25, 25], NC layer 2: 5^4 kernel, 16 -> 16 channels
+(~125 GFLOP/sample => 2 TFLOP/batch forward).
+"""
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def _time_once(fn, *args):
+    out = fn(*args)
+    leaves = jax.tree_util.tree_leaves(out)
+    t0 = time.perf_counter()
+    float(jnp.sum(leaves[0]))
+    return time.perf_counter() - t0
+
+
+def time_chain(make_chain, n_lo=1, n_hi=6, iters=3):
+    """Per-iteration seconds via the (n_hi - n_lo) slope.
+
+    ``make_chain(n)`` must return ``(jitted_fn, args)`` running the op n
+    times with data dependencies between repeats.
+    """
+    results = {}
+    for n in (n_lo, n_hi):
+        fn, args = make_chain(n)
+        fn(*args)  # compile
+        _time_once(fn, *args)  # warmup
+        results[n] = min(_time_once(fn, *args) for _ in range(iters))
+    return (results[n_hi] - results[n_lo]) / (n_hi - n_lo)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--grid", type=int, default=25)
+    p.add_argument("--ch", type=int, default=16)
+    p.add_argument("--ksize", type=int, default=5)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--impls", default="xla,taps,scan,tlc,tf3,tf2")
+    p.add_argument("--grad", action="store_true", help="also time fwd+bwd")
+    args = p.parse_args()
+
+    from ncnet_tpu.ops.conv4d import conv4d
+
+    b, g, c, k = args.batch, args.grid, args.ch, args.ksize
+    dtype = jnp.dtype(args.dtype)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(b, g, g, g, g, c), dtype)
+    w = jnp.asarray(rng.randn(k, k, k, k, c, c) * 0.01, dtype)
+    bias = jnp.asarray(rng.randn(c) * 0.01, dtype)
+
+    flops = 2.0 * b * g**4 * k**4 * c * c
+    print(
+        f"conv4d [{b},{g}^4,{c}]->[{c}] k={k}^4 {dtype.name}: "
+        f"{flops / 1e12:.3f} TFLOP fwd (slope timing)"
+    )
+
+    for impl in args.impls.split(","):
+
+        def make_fwd_chain(n, impl=impl):
+            @jax.jit
+            def f(x0, w_, b_):
+                y = x0
+                for _ in range(n):
+                    y = conv4d(y, w_, b_, impl=impl)
+                    y = jnp.tanh(y)  # keep magnitudes bounded, break CSE
+                return y
+
+            return f, (x, w, bias)
+
+        try:
+            dt = time_chain(make_fwd_chain)
+        except Exception as e:
+            print(f"  {impl:5s}: FAILED {type(e).__name__}: {str(e)[:120]}")
+            continue
+        print(
+            f"  {impl:5s} fwd : {dt * 1e3:8.2f} ms  "
+            f"{flops / dt / 1e12:7.2f} TFLOP/s"
+        )
+        if not args.grad:
+            continue
+
+        def make_grad_chain(n, impl=impl):
+            def loss(x_, w_, b_):
+                return jnp.sum(
+                    jnp.tanh(conv4d(x_, w_, b_, impl=impl)).astype(jnp.float32)
+                )
+
+            gradf = jax.grad(loss, argnums=(0, 1, 2))
+
+            @jax.jit
+            def f(x0, w_, b_):
+                xx, ww, bb = x0, w_, b_
+                for _ in range(n):
+                    dx, dw, db = gradf(xx, ww, bb)
+                    xx = xx + 1e-3 * dx.astype(dtype)
+                    ww = ww + 1e-3 * dw.astype(dtype)
+                    bb = bb + 1e-3 * db.astype(dtype)
+                return ww
+
+            return f, (x, w, bias)
+
+        try:
+            dt = time_chain(make_grad_chain)
+        except Exception as e:
+            print(f"  {impl:5s}: grad FAILED {type(e).__name__}: {str(e)[:120]}")
+            continue
+        print(
+            f"  {impl:5s} f+b : {dt * 1e3:8.2f} ms  "
+            f"{3 * flops / dt / 1e12:7.2f} TFLOP/s (3x fwd FLOPs)"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""A/B part-timings for the conv4d formulations: isolate the conv from the
+epilogue, and measure XLA conv throughput vs channel widths/ranks."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def timeit(fn, *args, iters=5, warmup=2):
+    for _ in range(warmup):
+        float(jnp.sum(fn(*args)))
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        float(jnp.sum(fn(*args)))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def conv_nd(x, w, nspatial):
+    letters = "jkl"[:nspatial]
+    dn = lax.conv_dimension_numbers(
+        x.shape, w.shape, (f"N{letters}C", f"{letters}IO", f"N{letters}C")
+    )
+    return lax.conv_general_dilated(
+        x, w, (1,) * nspatial, "SAME", dimension_numbers=dn,
+        preferred_element_type=x.dtype,
+    )
+
+
+def main():
+    rng = np.random.RandomState(0)
+    dt = jnp.bfloat16
+    cases = [
+        # (name, batch, spatial, cin, cout, ksize)
+        ("conv3d 400x25^3 16->16 k5", 400, (25, 25, 25), 16, 16, 5),
+        ("conv3d 400x25^3 16->80 k5", 400, (25, 25, 25), 16, 80, 5),
+        ("conv3d 400x25^3 16->128 k5", 400, (25, 25, 25), 16, 128, 5),
+        ("conv2d 10000x25^2 16->400 k5", 10000, (25, 25), 16, 400, 5),
+        ("conv2d 10000x25^2 16->512 k5", 10000, (25, 25), 16, 512, 5),
+        ("conv2d 2500x50^2 16->400 k5", 2500, (50, 50), 16, 400, 5),
+        ("conv1d 250000x25 16->2000 k5", 250000, (25,), 16, 2000, 5),
+    ]
+    for name, b, sp, cin, cout, k in cases:
+        x = jnp.asarray(rng.randn(b, *sp, cin), dt)
+        w = jnp.asarray(rng.randn(*([k] * len(sp)), cin, cout) * 0.01, dt)
+        f = jax.jit(lambda x_, w_, n=len(sp): conv_nd(x_, w_, n))
+        try:
+            t = timeit(f, x, w)
+        except Exception as e:
+            print(f"{name}: FAILED {type(e).__name__}")
+            continue
+        flops = 2.0 * b * np.prod(sp) * k ** len(sp) * cin * cout
+        print(f"{name}: {t*1e3:8.2f} ms  {flops/t/1e12:7.2f} TFLOP/s")
+
+    # epilogue cost of tf3: pad + 5 shifted slice adds on [16,25,25,25,25,5,16]
+    y = jnp.asarray(rng.randn(16, 25, 25, 25, 25, 5, 16), dt)
+
+    def epilogue(y_):
+        yp = jnp.pad(y_, ((0, 0), (2, 2)) + ((0, 0),) * 5)
+        out = None
+        for di in range(5):
+            t_ = yp[:, di : di + 25, :, :, :, di, :]
+            out = t_ if out is None else out + t_
+        return out
+
+    t = timeit(jax.jit(epilogue), y)
+    print(f"tf3 epilogue: {t*1e3:8.2f} ms")
+
+    # giant GEMM sanity: [250k, 2000] @ [2000, 128]
+    a = jnp.asarray(rng.randn(250000, 2000), dt)
+    bm = jnp.asarray(rng.randn(2000, 128) * 0.01, dt)
+    t = timeit(jax.jit(lambda a_, b_: a_ @ b_), a, bm)
+    print(f"gemm 250k x 2000 x 128: {t*1e3:8.2f} ms  {2*250000*2000*128/t/1e12:7.2f} TFLOP/s")
+    bm2 = jnp.asarray(rng.randn(2000, 512) * 0.01, dt)
+    t = timeit(jax.jit(lambda a_, b_: a_ @ b_), a, bm2)
+    print(f"gemm 250k x 2000 x 512: {t*1e3:8.2f} ms  {2*250000*2000*512/t/1e12:7.2f} TFLOP/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,151 @@
+"""Bisect the training step: slope-time its pieces on the real chip.
+
+Pieces: trunk features, one chunk's match pipeline fwd, whole loss fwd,
+whole train step (f+b). Slope timing (chained repeats, one D2H) cancels
+the ~80 ms sync latency of this platform.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def _sync(out):
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+
+
+def time_chain(make_chain, n_lo=1, n_hi=5, iters=3):
+    res = {}
+    for n in (n_lo, n_hi):
+        fn, args = make_chain(n)
+        _sync(fn(*args))
+        _sync(fn(*args))
+        ts = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            _sync(fn(*args))
+            ts.append(time.perf_counter() - t0)
+        res[n] = min(ts)
+    return (res[n_hi] - res[n_lo]) / (n_hi - n_lo)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--conv4d_impl", default="cf")
+    p.add_argument("--loss_chunk", type=int, default=4)
+    p.add_argument("--batch", type=int, default=16)
+    args = p.parse_args()
+
+    from ncnet_tpu.models.immatchnet import (
+        ImMatchNetConfig,
+        extract_features,
+        init_immatchnet,
+        match_pipeline,
+    )
+    from ncnet_tpu.train.loss import weak_loss
+    from ncnet_tpu.train.step import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    config = ImMatchNetConfig(
+        ncons_kernel_sizes=(5, 5, 5),
+        ncons_channels=(16, 16, 1),
+        half_precision=True,
+        conv4d_impl=args.conv4d_impl,
+        loss_chunk=args.loss_chunk,
+    )
+    params = init_immatchnet(jax.random.PRNGKey(0), config)
+    rng = np.random.RandomState(0)
+    batch = {
+        "source_image": jnp.asarray(
+            rng.randn(args.batch, 400, 400, 3).astype(np.float32)
+        ),
+        "target_image": jnp.asarray(
+            rng.randn(args.batch, 400, 400, 3).astype(np.float32)
+        ),
+    }
+
+    # 1) trunk features, 2*batch images
+    imgs = jnp.concatenate([batch["source_image"], batch["target_image"]])
+
+    def mk_feat(n):
+        @jax.jit
+        def f(p, x):
+            y = x
+            out = None
+            for _ in range(n):
+                out = extract_features(p, config, y)
+                y = y + 1e-6
+            return out
+
+        return f, (params, imgs)
+
+    print(f"trunk fwd x{2 * args.batch} imgs: {time_chain(mk_feat) * 1e3:8.1f} ms")
+
+    # 2) one chunk's pipeline fwd (pos only), chunk samples
+    c = args.loss_chunk or args.batch
+    feat = jax.jit(lambda p, x: extract_features(p, config, x))(
+        params, imgs[: 2 * c]
+    )
+    fa, fb = feat[:c], feat[c : 2 * c]
+
+    def mk_pipe(n):
+        @jax.jit
+        def f(nc, fa_, fb_):
+            out = None
+            x = fa_
+            for _ in range(n):
+                out = match_pipeline(nc, config, x, fb_)
+                x = x + 1e-6
+            return out
+
+        return f, (params["neigh_consensus"], fa, fb)
+
+    print(f"pipeline fwd (chunk {c}):     {time_chain(mk_pipe) * 1e3:8.1f} ms")
+
+    # 3) whole loss fwd
+    def mk_loss(n):
+        @jax.jit
+        def f(p, b):
+            out = 0.0
+            bb = b
+            for _ in range(n):
+                out = out + weak_loss(p, config, bb)
+                bb = {k: v + 1e-6 for k, v in bb.items()}
+            return out
+
+        return f, (params, batch)
+
+    print(f"loss fwd (batch {args.batch}):         {time_chain(mk_loss) * 1e3:8.1f} ms")
+
+    # 4) full train step
+    optimizer = make_optimizer()
+    state = create_train_state(params, optimizer)
+    step = make_train_step(config, optimizer, donate=False)
+    state, loss = step(state, batch)
+    _sync(loss)
+    for n in (1, 5):
+        pass
+    ts = {}
+    for n in (1, 5):
+        t0 = time.perf_counter()
+        s = state
+        for _ in range(n):
+            s, loss = step(s, batch)
+        _sync(loss)
+        ts[n] = time.perf_counter() - t0
+    print(f"train step (f+b):           {(ts[5] - ts[1]) / 4 * 1e3:8.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

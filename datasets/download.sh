@@ -1,0 +1,37 @@
+#!/bin/bash
+# Dataset bootstrap for ncnet_tpu. Pair-list CSVs are vendored in-repo;
+# images must be fetched (no network egress in the build environment, so
+# run this wherever you have connectivity).
+#
+# Sources match the reference repo's download scripts
+# (reference datasets/pf-pascal/download.sh, datasets/ivd/download.sh,
+# datasets/inloc/download.sh).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+case "${1:-all}" in
+  pf-pascal|all)
+    (
+      cd pf-pascal
+      wget -nc https://www.di.ens.fr/willow/research/proposalflow/dataset/PF-dataset-PASCAL.zip
+      unzip -n PF-dataset-PASCAL.zip 'PF-dataset-PASCAL/JPEGImages/*'
+    )
+    ;;&
+  ivd|all)
+    (
+      cd ivd
+      # one directory per venue, then 3708 Google-hosted images
+      while read -r path _; do mkdir -p "$path"; done < dirs.txt
+      <urls.txt xargs -n2 -P8 wget -nc -O
+    )
+    ;;&
+  inloc|all)
+    (
+      cd inloc
+      wget -nc http://www.ok.sc.e.titech.ac.jp/INLOC/materials/cutouts.tar.gz
+      wget -nc http://www.ok.sc.e.titech.ac.jp/INLOC/materials/iphone7.tar.gz
+      # densePE_top100_shortlist_cvpr18.mat (the query->pano shortlist) is
+      # distributed with the InLoc_demo project; place it in this directory.
+    )
+    ;;&
+esac

@@ -174,13 +174,16 @@ class SyntheticPairDataset:
     """Synthetic stand-in when no image data is on disk (CI, benchmarks).
 
     Target = source warped by a random horizontal roll, so trained models
-    have real (cyclic-translation) structure to learn.
+    have real (cyclic-translation) structure to learn — and a KNOWN dense
+    correspondence: source pixel (x, y) appears at target (x + shift mod W,
+    y), which `eval.synthetic` uses for a PCK-style transfer metric.
     """
 
-    def __init__(self, n=256, output_size=(400, 400), seed=0):
+    def __init__(self, n=256, output_size=(400, 400), seed=0, return_shift=False):
         self.n = n
         self.out_h, self.out_w = output_size
         self.seed = seed
+        self.return_shift = return_shift
 
     def __len__(self):
         return self.n
@@ -191,8 +194,11 @@ class SyntheticPairDataset:
         img = resize_bilinear_np(base * 255.0, self.out_h, self.out_w)
         shift = rng.randint(0, self.out_w // 2)
         tgt = np.roll(img, shift, axis=1)
-        return {
+        out = {
             "source_image": normalize_image_np(img),
             "target_image": normalize_image_np(tgt),
             "set_class": np.float32(0),
         }
+        if self.return_shift:
+            out["shift"] = np.float32(shift)
+        return out

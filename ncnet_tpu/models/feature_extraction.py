@@ -31,13 +31,22 @@ def init_feature_extraction(rng, cnn="resnet101"):
     return BACKBONES[cnn][0](rng)
 
 
-def feature_extraction_apply(params, image, cnn="resnet101", normalize=True, dtype=None):
+def feature_extraction_apply(
+    params, image, cnn="resnet101", normalize=True, dtype=None, center=False
+):
     """``[b, h, w, 3]`` normalized image -> L2-normalized feature map.
 
     Args:
       dtype: optional compute dtype override (e.g. jnp.bfloat16) applied to
         the input and parameters — TPU-native replacement for the reference's
         fp16 eval mode (lib/model.py:253-258).
+      center: subtract the per-image spatial mean before normalizing.
+        Framework extension (off by default = reference semantics): ReLU
+        features of a randomly-initialized trunk collapse into the positive
+        orthant (measured pairwise cosines 0.62-1.0), which starves the
+        correlation of contrast; centering restores it (mean ~0, peaks ~1).
+        Used by the synthetic convergence proof, where no pretrained weights
+        exist.
     """
     apply_fn = BACKBONES[cnn][1]
     if dtype is not None:
@@ -46,6 +55,10 @@ def feature_extraction_apply(params, image, cnn="resnet101", normalize=True, dty
         params = jax.tree.map(lambda p: p.astype(dtype), params)
         image = image.astype(dtype)
     feats = apply_fn(params, image)
+    if center:
+        import jax.numpy as jnp
+
+        feats = feats - jnp.mean(feats, axis=(1, 2), keepdims=True)
     if normalize:
         feats = feature_l2norm(feats, axis=-1)
     return feats

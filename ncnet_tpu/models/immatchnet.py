@@ -44,6 +44,13 @@ class ImMatchNetConfig:
     half_precision: bool = False  # bf16 feature/correlation path (TPU-native fp16)
     conv4d_impl: str = "xla"
     nc_remat: bool = False  # rematerialize each NC layer in the backward pass
+    # Run the correlation->NC->score pipeline over sample chunks of this
+    # size in the training loss (0 = whole batch): bounds the live 4D
+    # tensors to the chunk, enabling the wide-lane conv4d impls at batch 16.
+    loss_chunk: int = 0
+    # Subtract the per-image spatial feature mean before L2-norm (framework
+    # extension, off = reference semantics; see feature_extraction_apply).
+    center_features: bool = False
 
     def replace(self, **kw):
         return dataclasses.replace(self, **kw)
@@ -115,6 +122,7 @@ def extract_features(params, config: ImMatchNetConfig, image):
         cnn=config.feature_extraction_cnn,
         normalize=config.normalize_features,
         dtype=dtype,
+        center=config.center_features,
     )
 
 

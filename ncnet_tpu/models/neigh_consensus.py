@@ -101,6 +101,7 @@ def neigh_consensus_apply(params, corr, symmetric=True, impl="xla", remat=False)
                     p["kernel"].astype(dtype),
                     kl,
                     p["bias"].astype(dtype),
+                    impl=impl,
                 )
             )
 

@@ -29,6 +29,55 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _toeplitz_l_weights(w, l_size):
+    """Expand ``w`` into a banded (Toeplitz) channel-mixing matrix over l.
+
+    The 4D convolution's reduction over the last spatial dim (l) and input
+    channels is re-expressed as a DENSE channel contraction ``(l', c) ->
+    (l, o)`` whose matrix is zero off the +-kl//2 band:
+
+      T[di, dj, dk, (l', c), (l, o)] = w[di, dj, dk, l'-l+pad, c, o]
+
+    This inflates FLOPs by ``l_size / kl`` (5x at the training grid 25) but
+    gives the MXU full 128-lane tiles (l*c = l*o = 400 at the PF-Pascal
+    config) instead of ``cout``-wide (16 or 1) output tiles, which cap
+    every direct formulation at ~12 TFLOP/s measured. Worth it for small
+    grids; see `conv4d` impl='tlc'.
+    """
+    ki, kj, kk, kl, cin, cout = w.shape
+    pad = kl // 2
+    lp = jnp.arange(l_size)[:, None]
+    lo = jnp.arange(l_size)[None, :]
+    dl = lp - lo + pad  # [l', l]
+    valid = (dl >= 0) & (dl < kl)
+    # take along the kl axis: [ki,kj,kk, l',l, cin,cout]
+    t = jnp.take(w, jnp.clip(dl, 0, kl - 1), axis=3)
+    t = jnp.where(valid[None, None, None, :, :, None, None], t, 0)
+    # -> [ki,kj,kk, l', cin, l, cout] -> [ki,kj,kk, l'*cin, l*cout]
+    t = t.transpose(0, 1, 2, 3, 5, 4, 6)
+    return t.reshape(ki, kj, kk, l_size * cin, l_size * cout)
+
+
+def _conv4d_tlc(x, w):
+    """conv4d as ONE conv3d over (i, j, k) with (l, c) fused into channels."""
+    b, i, j, k, l, cin = x.shape
+    cout = w.shape[-1]
+    t = _toeplitz_l_weights(w, l).astype(x.dtype)
+    x3 = x.reshape(b, i, j, k, l * cin)
+    dn = lax.conv_dimension_numbers(
+        x3.shape, t.shape, ("NijkC", "ijkIO", "NijkC")
+    )
+    out = lax.conv_general_dilated(
+        x3,
+        t,
+        window_strides=(1, 1, 1),
+        padding="SAME",
+        dimension_numbers=dn,
+        preferred_element_type=x.dtype,
+    )
+    return out.reshape(b, i, j, k, l, cout)
+
+
 def _conv4d_xla(x, w):
     dn = lax.conv_dimension_numbers(
         x.shape, w.shape, ("NijklC", "ijklIO", "NijklC")
@@ -104,7 +153,7 @@ def _conv4d_scan(x, w):
     return jnp.moveaxis(out, 0, 1)
 
 
-def conv4d_packed(xp, w, kl_shape, bias=None):
+def conv4d_packed(xp, w, kl_shape, bias=None, impl="scan"):
     """4D convolution on the fused layout ``[b, i, j, k*l*c]`` (c fastest).
 
     TPU memory-layout native: the channels-minor 6D activation layout pads
@@ -122,10 +171,22 @@ def conv4d_packed(xp, w, kl_shape, bias=None):
       w: ``[ki, kj, kk, kl, c_in, c_out]``.
       kl_shape: the static (k, l) factorization of the fused dim.
       bias: optional ``[c_out]``.
+      impl: 'scan' (sequential over i, O(1/I) live memory, implemented
+        directly on the packed layout below) or any `conv4d` impl name
+        ('tlc', 'tf3', ... — fastest at small grids), routed through a pure
+        unpack -> conv4d -> repack; all consume/produce the packed layout.
 
     Returns:
       ``[b, i, j, k*l*c_out]``.
     """
+    if impl != "scan":
+        b, i, j, fused = xp.shape
+        k, l = kl_shape
+        cin = w.shape[-2]
+        cout = w.shape[-1]
+        assert k * l * cin == fused, (kl_shape, cin, fused)
+        out = conv4d(xp.reshape(b, i, j, k, l, cin), w, bias=bias, impl=impl)
+        return out.reshape(b, i, j, k * l * cout)
     ki = w.shape[0]
     pad = ki // 2
     b, i, j, fused = xp.shape
@@ -160,6 +221,158 @@ def conv4d_packed(xp, w, kl_shape, bias=None):
     return jnp.moveaxis(out, 0, 1)  # [b, i, j, k*l*cout]
 
 
+def _conv4d_tapsfused3(x, w):
+    """Fuse the ki taps into output channels of ONE conv3d, then shift-sum.
+
+    The MXU lane dim carries conv output channels; with cout<=16 every
+    direct lowering wastes >=7/8 of the lanes (measured ~11 TFLOP/s). Here
+    one conv3d over (j, k, l) produces ``ki * cout`` channels — the
+    contribution of each leading-dim tap — and the cheap epilogue shifts
+    each tap group along i and sums: identical math, ki-times wider lanes.
+    """
+    b, i, j, k, l, cin = x.shape
+    ki, kj, kk, kl, _, cout = w.shape
+    pad = ki // 2
+    w2 = w.transpose(1, 2, 3, 4, 0, 5).reshape(kj, kk, kl, cin, ki * cout)
+    x3 = x.reshape(b * i, j, k, l, cin)
+    dn = lax.conv_dimension_numbers(
+        x3.shape, w2.shape, ("NjklC", "jklIO", "NjklC")
+    )
+    y = lax.conv_general_dilated(
+        x3,
+        w2,
+        window_strides=(1, 1, 1),
+        padding="SAME",
+        dimension_numbers=dn,
+        preferred_element_type=x.dtype,
+    ).reshape(b, i, j, k, l, ki * cout)
+    # out[:, m] = sum_di y[:, m + di - pad, ..., di-th channel block].
+    # Channel blocks are sliced on the FUSED trailing dim: a 7D view with a
+    # trailing (ki, cout) pair tiles to 5x HBM padding on TPU (measured
+    # OOM), the 6D fused form stays ~2x.
+    ypad = jnp.pad(y, ((0, 0), (pad, pad)) + ((0, 0),) * 4)
+    out = None
+    for di in range(ki):
+        term = ypad[:, di : di + i, :, :, :, di * cout : (di + 1) * cout]
+        out = term if out is None else out + term
+    return out
+
+
+def _conv4d_tapsfused2(x, w):
+    """Fuse the (ki, kj) taps into output channels of ONE conv2d over (k, l),
+    then shift-sum over (i, j). Lane width ``ki*kj*cout`` (400 at the
+    PF-Pascal config) — full MXU tiles; epilogue is elementwise."""
+    b, i, j, k, l, cin = x.shape
+    ki, kj, kk, kl, _, cout = w.shape
+    pi, pj = ki // 2, kj // 2
+    w2 = w.transpose(2, 3, 4, 0, 1, 5).reshape(kk, kl, cin, ki * kj * cout)
+    x2 = x.reshape(b * i * j, k, l, cin)
+    dn = lax.conv_dimension_numbers(
+        x2.shape, w2.shape, ("NklC", "klIO", "NklC")
+    )
+    y = lax.conv_general_dilated(
+        x2,
+        w2,
+        window_strides=(1, 1),
+        padding="SAME",
+        dimension_numbers=dn,
+        preferred_element_type=x.dtype,
+    ).reshape(b, i, j, k, l, ki * kj * cout)
+    ypad = jnp.pad(y, ((0, 0), (pi, pi), (pj, pj)) + ((0, 0),) * 3)
+    out = None
+    for di in range(ki):
+        for dj in range(kj):
+            t = di * kj + dj
+            term = ypad[
+                :, di : di + i, dj : dj + j, :, :, t * cout : (t + 1) * cout
+            ]
+            out = term if out is None else out + term
+    return out
+
+
+def _cf_kernel(w):
+    """[ki,kj,kk,kl,cin,cout] -> conv2d kernel [kk, kl, ki*cin, kj*cout]
+    with (di major, c minor) input blocks and (dj major, o minor) output
+    blocks."""
+    ki, kj, kk, kl, cin, cout = w.shape
+    return w.transpose(2, 3, 0, 4, 1, 5).reshape(kk, kl, ki * cin, kj * cout)
+
+
+def _conv4d_cf(x, w):
+    """Channel-fused conv4d: ONE conv2d over (k, l) with the ki leading taps
+    folded into input channels and the kj taps into output channels.
+
+    in-channels = ki*cin, out-channels = kj*cout (80 at the PF-Pascal
+    config): full MXU lane tiles in the forward AND both backward convs —
+    the narrow-cout formulations cap at ~12% utilization, and XLA's conv
+    was measured at >150 TFLOP/s once lanes are wide. True FLOP count
+    (every tap computed once); epilogue is a cheap shift-sum over j using
+    channel-block slices, so no high-rank intermediates that tile badly.
+    """
+    b, i, j, k, l, cin = x.shape
+    ki, kj, kk, kl, _, cout = w.shape
+    pi, pj = ki // 2, kj // 2
+    xpad = jnp.pad(x, ((0, 0), (pi, pi)) + ((0, 0),) * 4)
+    # [b, i, j, k, l, ki*cin]: channel block di holds x shifted by di-pi in i
+    xs = jnp.concatenate([xpad[:, di : di + i] for di in range(ki)], axis=-1)
+    x2 = xs.reshape(b * i * j, k, l, ki * cin)
+    w2 = _cf_kernel(w)
+    dn = lax.conv_dimension_numbers(
+        x2.shape, w2.shape, ("NklC", "klIO", "NklC")
+    )
+    y = lax.conv_general_dilated(
+        x2,
+        w2,
+        window_strides=(1, 1),
+        padding="SAME",
+        dimension_numbers=dn,
+        preferred_element_type=x.dtype,
+    ).reshape(b, i, j, k, l, kj * cout)
+    # out[:, :, m] = sum_dj y[:, :, m + dj - pj, ..., dj-th channel block]
+    ypad = jnp.pad(y, ((0, 0), (0, 0), (pj, pj)) + ((0, 0),) * 3)
+    out = None
+    for dj in range(kj):
+        term = ypad[:, :, dj : dj + j, :, :, dj * cout : (dj + 1) * cout]
+        out = term if out is None else out + term
+    return out
+
+
+def _conv4d_cfs(x, w):
+    """`_conv4d_cf` restructured as a `lax.scan` over the leading spatial
+    dim: O(1/I) live memory (the reference loop's memory shape,
+    lib/conv4d.py:39-48) with the same wide-lane conv2d inside."""
+    b, i, j, k, l, cin = x.shape
+    ki, kj, kk, kl, _, cout = w.shape
+    pi, pj = ki // 2, kj // 2
+    xpad = jnp.pad(x, ((0, 0), (pi, pi)) + ((0, 0),) * 4)
+    w2 = _cf_kernel(w)
+    dn = lax.conv_dimension_numbers(
+        (b * j, k, l, ki * cin), w2.shape, ("NklC", "klIO", "NklC")
+    )
+
+    def slice_out(_, out_i):
+        window = lax.dynamic_slice_in_dim(xpad, out_i, ki, axis=1)
+        # [b, ki, j, k, l, c] -> [b, j, k, l, ki*cin] (di major, c minor)
+        xs = window.transpose(0, 2, 3, 4, 1, 5).reshape(b, j, k, l, ki * cin)
+        y = lax.conv_general_dilated(
+            xs.reshape(b * j, k, l, ki * cin),
+            w2,
+            window_strides=(1, 1),
+            padding="SAME",
+            dimension_numbers=dn,
+            preferred_element_type=x.dtype,
+        ).reshape(b, j, k, l, kj * cout)
+        ypad = jnp.pad(y, ((0, 0), (pj, pj)) + ((0, 0),) * 3)
+        acc = None
+        for dj in range(kj):
+            term = ypad[:, dj : dj + j, :, :, dj * cout : (dj + 1) * cout]
+            acc = term if acc is None else acc + term
+        return None, acc
+
+    _, out = lax.scan(slice_out, None, jnp.arange(i))
+    return jnp.moveaxis(out, 0, 1)
+
+
 def conv4d(x, w, bias=None, impl="xla"):
     """SAME, stride-1 4D convolution.
 
@@ -168,7 +381,12 @@ def conv4d(x, w, bias=None, impl="xla"):
       w: ``[ki, kj, kk, kl, c_in, c_out]`` (odd kernel sizes).
       bias: optional ``[c_out]``, added once (reference bias-at-center-tap
         semantics, lib/conv4d.py:41-48).
-      impl: 'xla' | 'taps'.
+      impl: 'xla' (one rank-4 conv HLO) | 'taps' (per-tap conv3d sum) |
+        'scan' (sequential over i, minimal memory) | 'tlc' (Toeplitz-l
+        conv3d, 5x FLOPs but wide lanes) | 'tf3'/'tf2' (taps folded into
+        output channels + shift-sum) | 'cf'/'cfs' (taps folded into BOTH
+        input and output channels of one conv2d — true FLOPs, wide lanes
+        both directions; 'cfs' is the scanned low-memory variant).
 
     Returns:
       ``[b, i, j, k, l, c_out]``.
@@ -179,6 +397,16 @@ def conv4d(x, w, bias=None, impl="xla"):
         out = _conv4d_taps(x, w)
     elif impl == "scan":
         out = _conv4d_scan(x, w)
+    elif impl == "tlc":
+        out = _conv4d_tlc(x, w)
+    elif impl == "tf3":
+        out = _conv4d_tapsfused3(x, w)
+    elif impl == "tf2":
+        out = _conv4d_tapsfused2(x, w)
+    elif impl == "cf":
+        out = _conv4d_cf(x, w)
+    elif impl == "cfs":
+        out = _conv4d_cfs(x, w)
     else:
         raise ValueError(f"unknown conv4d impl: {impl!r}")
     if bias is not None:

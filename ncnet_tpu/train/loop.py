@@ -47,8 +47,11 @@ def train(
     checkpoint_name="ncnet_tpu.msgpack",
     data_parallel=True,
     start_epoch=0,
+    start_step=0,
     opt_state=None,
     initial_best_val=None,
+    initial_train_hist=None,
+    initial_val_hist=None,
     log_every=10,
 ):
     mesh = make_mesh() if data_parallel and len(jax.devices()) > 1 else None
@@ -56,7 +59,7 @@ def train(
         params = replicate(mesh, params)
 
     optimizer = make_optimizer(learning_rate)
-    state = create_train_state(params, optimizer, train_fe)
+    state = create_train_state(params, optimizer, train_fe, step=start_step)
     if opt_state is not None:
         if isinstance(opt_state, dict):
             # raw state dict from a checkpoint loaded without a target
@@ -71,7 +74,12 @@ def train(
     eval_step = make_eval_step(config)
 
     best_val = float("inf") if initial_best_val is None else float(initial_best_val)
-    train_hist, val_hist = [], []
+    # Resume continues the loss histories rather than restarting them (the
+    # reference keeps full train_loss/test_loss arrays, train.py:197-205).
+    train_hist = [float(v) for v in np.asarray(initial_train_hist).ravel()] \
+        if initial_train_hist is not None else []
+    val_hist = [float(v) for v in np.asarray(initial_val_hist).ravel()] \
+        if initial_val_hist is not None else []
     for epoch in range(start_epoch, num_epochs):
         t0 = time.time()
         losses = []

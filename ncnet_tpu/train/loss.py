@@ -13,6 +13,7 @@ is applied functionally to the *extracted source features* (identical result
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ncnet_tpu.models.immatchnet import extract_features, match_pipeline
 
@@ -27,22 +28,37 @@ def _normalize(x, axis, normalization):
     raise ValueError(f"unknown score normalization {normalization!r}")
 
 
-def match_score(corr, normalization="softmax"):
-    """Mean of the best normalized match score, both directions.
+def match_score_per_sample(corr, normalization="softmax"):
+    """Per-sample best normalized match score, both directions averaged.
 
-    ``corr``: ``[b, fs1, fs2, fs3, fs4]``. Returns a scalar: the
-    reference's ``mean(scores_A + scores_B) / 2`` (train.py:125-134).
+    ``corr``: ``[b, fs1, fs2, fs3, fs4]``. Returns ``[b]``; the reference's
+    scalar score (train.py:125-134) is the mean of this over the batch.
     """
     b, fs1, fs2, fs3, fs4 = corr.shape
     b_avec = corr.reshape(b, fs1 * fs2, fs3, fs4)  # scores over A per B cell
     a_bvec = corr.reshape(b, fs1, fs2, fs3 * fs4)  # scores over B per A cell
     scores_b = jnp.max(_normalize(b_avec, 1, normalization), axis=1)
     scores_a = jnp.max(_normalize(a_bvec, 3, normalization), axis=3)
-    return (jnp.mean(scores_a) + jnp.mean(scores_b)) / 2
+    return (
+        jnp.mean(scores_a, axis=(1, 2)) + jnp.mean(scores_b, axis=(1, 2))
+    ) / 2
+
+
+def match_score(corr, normalization="softmax"):
+    """Mean of the best normalized match score, both directions (scalar)."""
+    return jnp.mean(match_score_per_sample(corr, normalization))
 
 
 def weak_loss(params, config, batch, normalization="softmax"):
-    """Positive-vs-rolled-negative weak supervision loss (scalar)."""
+    """Positive-vs-rolled-negative weak supervision loss (scalar).
+
+    When ``config.loss_chunk`` > 0 the post-backbone pipeline (correlation
+    -> MM -> NC -> MM -> score) runs over sample chunks of that size via
+    `lax.map` with rematerialization per chunk: peak memory for the big 4D
+    tensors scales with the chunk, not the batch. Identical math — the
+    rolled-negative pairing is fixed on the full batch of features BEFORE
+    chunking, and all scores are per-sample means.
+    """
     if config.relocalization_k_size > 1:
         raise ValueError(
             "weak_loss does not support relocalization configs "
@@ -51,12 +67,34 @@ def weak_loss(params, config, batch, normalization="softmax"):
         )
     feat_a = extract_features(params, config, batch["source_image"])
     feat_b = extract_features(params, config, batch["target_image"])
-
-    corr_pos = match_pipeline(params["neigh_consensus"], config, feat_a, feat_b)
-    score_pos = match_score(corr_pos, normalization)
-
     feat_a_neg = jnp.roll(feat_a, -1, axis=0)
-    corr_neg = match_pipeline(params["neigh_consensus"], config, feat_a_neg, feat_b)
-    score_neg = match_score(corr_neg, normalization)
+    nc_params = params["neigh_consensus"]
+
+    def pair_scores(fa, fb, fan):
+        corr_pos = match_pipeline(nc_params, config, fa, fb)
+        corr_neg = match_pipeline(nc_params, config, fan, fb)
+        return (
+            match_score_per_sample(corr_pos, normalization),
+            match_score_per_sample(corr_neg, normalization),
+        )
+
+    chunk = getattr(config, "loss_chunk", 0) or 0
+    b = feat_a.shape[0]
+    if 0 < chunk < b:
+        if b % chunk:
+            raise ValueError(f"batch {b} not divisible by loss_chunk {chunk}")
+        shape = (b // chunk, chunk) + feat_a.shape[1:]
+        chunks = (
+            feat_a.reshape(shape),
+            feat_b.reshape(shape),
+            feat_a_neg.reshape(shape),
+        )
+        pos, neg = lax.map(
+            jax.checkpoint(lambda t: pair_scores(*t)), chunks
+        )
+        score_pos, score_neg = jnp.mean(pos), jnp.mean(neg)
+    else:
+        pos, neg = pair_scores(feat_a, feat_b, feat_a_neg)
+        score_pos, score_neg = jnp.mean(pos), jnp.mean(neg)
 
     return score_neg - score_pos

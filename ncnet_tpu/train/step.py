@@ -31,9 +31,9 @@ def make_optimizer(learning_rate=5e-4):
     return optax.adam(learning_rate)
 
 
-def create_train_state(params, optimizer, train_fe=False):
+def create_train_state(params, optimizer, train_fe=False, step=0):
     opt_state = optimizer.init(trainable_subset(params, train_fe))
-    return TrainState(params=params, opt_state=opt_state, step=0)
+    return TrainState(params=params, opt_state=opt_state, step=step)
 
 
 def make_train_step(

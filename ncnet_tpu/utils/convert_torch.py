@@ -116,6 +116,43 @@ def convert_neigh_consensus(state_dict, prefix="NeighConsensus.conv.", pre_permu
     return params
 
 
+def load_trunk_weights(path, cnn="resnet101"):
+    """Load backbone trunk weights from any supported source file.
+
+    Accepts:
+      * a reference ``.pth.tar`` training checkpoint (keys under
+        ``FeatureExtraction.model.``, possibly legacy ``vgg.``-prefixed);
+      * a raw torchvision state dict (``.pth``, keys like ``conv1.weight``,
+        ``layer1.0.conv1.weight`` / ``features.0.weight``);
+      * an ncnet_tpu msgpack checkpoint (takes its
+        ``params['feature_extraction']``).
+
+    Returns the ``feature_extraction`` param tree for ``cnn``.
+    """
+    if path.endswith(".msgpack"):
+        from ncnet_tpu.train.checkpoint import load_checkpoint
+
+        return load_checkpoint(path).params["feature_extraction"]
+
+    import torch
+
+    blob = torch.load(path, map_location="cpu", weights_only=False)
+    sd = blob.get("state_dict", blob) if isinstance(blob, dict) else blob
+    sd = {k.replace("vgg", "model"): v for k, v in sd.items()}
+    prefix = (
+        "FeatureExtraction.model."
+        if any(k.startswith("FeatureExtraction.model.") for k in sd)
+        else ""
+    )
+    if cnn == "resnet101":
+        return convert_resnet101_trunk(sd, prefix=prefix)
+    if cnn == "vgg":
+        if prefix == "" and any(k.startswith("features.") for k in sd):
+            prefix = "features."
+        return convert_vgg16_trunk(sd, prefix=prefix)
+    raise ValueError(f"unsupported backbone for trunk conversion: {cnn!r}")
+
+
 def convert_checkpoint(path):
     """Load a reference .pth.tar and return ``(config, params)``.
 

@@ -1,0 +1,141 @@
+"""Match/keypoint visualization — reference lib/plot.py:6-29 (un-normalize
++ imshow, tight savefig) plus a side-by-side match drawing equivalent to
+the demo notebook cells 3-7 and lib_matlab/show_matches2_horizontal.m.
+
+matplotlib is imported lazily with the Agg backend so headless
+environments work.
+"""
+
+import numpy as np
+
+from ncnet_tpu.data.images import IMAGENET_MEAN, IMAGENET_STD
+
+
+def unnormalize_image_np(image):
+    """ImageNet-normalized [h, w, 3] -> displayable float RGB in [0, 1]."""
+    img = np.asarray(image, np.float32)
+    return np.clip(img * IMAGENET_STD + IMAGENET_MEAN, 0.0, 1.0)
+
+
+def _plt():
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def plot_image(image, normalized=True, ax=None):
+    """imshow an image tensor ([h, w, 3] or [1, h, w, 3]), un-normalizing
+    if needed (reference plot_image, lib/plot.py:6-19)."""
+    plt = _plt()
+    img = np.asarray(image)
+    if img.ndim == 4:
+        img = img[0]
+    if normalized:
+        img = unnormalize_image_np(img)
+    else:
+        img = np.clip(img / 255.0, 0, 1) if img.max() > 2 else np.clip(img, 0, 1)
+    if ax is None:
+        ax = plt.gca()
+    ax.imshow(img)
+    ax.axis("off")
+    return ax
+
+
+def save_plot(filename, fig=None, dpi=150):
+    """Tight savefig (reference save_plot, lib/plot.py:22-29)."""
+    plt = _plt()
+    if fig is None:
+        fig = plt.gcf()
+    fig.savefig(filename, dpi=dpi, bbox_inches="tight", pad_inches=0.05)
+
+
+def draw_point_transfer(
+    source_image,
+    target_image,
+    source_points,
+    warped_points,
+    target_points,
+    out_path,
+    normalized=True,
+    title=None,
+):
+    """Side-by-side keypoint-transfer figure.
+
+    Left: source image with ground-truth source keypoints (green) and the
+    model-warped target keypoints (red x) joined by error lines. Right:
+    target image with the query keypoints. Equivalent information to the
+    reference demo's final cell.
+
+    Args:
+      source_points, warped_points: ``[2, N]`` pixel coords in the source.
+      target_points: ``[2, N]`` pixel coords in the target.
+    """
+    plt = _plt()
+    fig, axes = plt.subplots(1, 2, figsize=(11, 5))
+    plot_image(source_image, normalized, ax=axes[0])
+    plot_image(target_image, normalized, ax=axes[1])
+    sp = np.asarray(source_points)
+    wp = np.asarray(warped_points)
+    tp = np.asarray(target_points)
+    valid = (sp[0] != -1) & (sp[1] != -1)
+    for i in np.nonzero(valid)[0]:
+        axes[0].plot(
+            [sp[0, i], wp[0, i]], [sp[1, i], wp[1, i]], "-", color="yellow", lw=1
+        )
+    axes[0].plot(sp[0, valid], sp[1, valid], "o", color="lime", ms=5, label="GT")
+    axes[0].plot(wp[0, valid], wp[1, valid], "x", color="red", ms=6, label="warped")
+    axes[0].legend(loc="lower right", fontsize=8)
+    axes[1].plot(tp[0, valid], tp[1, valid], "o", color="cyan", ms=5)
+    if title:
+        fig.suptitle(title)
+    save_plot(out_path, fig)
+    plt.close(fig)
+    return out_path
+
+
+def draw_matches(
+    source_image,
+    target_image,
+    matches_xyxy,
+    scores,
+    out_path,
+    top_k=100,
+    normalized=True,
+):
+    """Horizontal side-by-side match-line plot
+    (lib_matlab/show_matches2_horizontal.m equivalent).
+
+    Args:
+      matches_xyxy: ``[N, 4]`` of (xA, yA, xB, yB) in [0, 1] normalized
+        image coordinates (the InLoc dump convention).
+      scores: ``[N]`` match scores; the top_k by score are drawn.
+    """
+    plt = _plt()
+    src = unnormalize_image_np(source_image) if normalized else source_image
+    tgt = unnormalize_image_np(target_image) if normalized else target_image
+    h = max(src.shape[0], tgt.shape[0])
+
+    def padto(img):
+        if img.shape[0] < h:
+            img = np.pad(img, ((0, h - img.shape[0]), (0, 0), (0, 0)))
+        return img
+
+    canvas = np.concatenate([padto(src), padto(tgt)], axis=1)
+    fig, ax = plt.subplots(figsize=(12, 5))
+    ax.imshow(canvas)
+    ax.axis("off")
+    m = np.asarray(matches_xyxy)
+    s = np.asarray(scores)
+    order = np.argsort(-s)[:top_k]
+    for i in order:
+        xa = m[i, 0] * src.shape[1]
+        ya = m[i, 1] * src.shape[0]
+        xb = m[i, 2] * tgt.shape[1] + src.shape[1]
+        yb = m[i, 3] * tgt.shape[0]
+        ax.plot([xa, xb], [ya, yb], "-", lw=0.6, alpha=0.7)
+    save_plot(out_path, fig)
+    plt.close(fig)
+    return out_path

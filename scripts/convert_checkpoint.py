@@ -1,0 +1,31 @@
+"""Convert a reference PyTorch checkpoint (.pth.tar) to an ncnet_tpu
+msgpack checkpoint (self-describing: architecture config embedded).
+
+Usage:
+  python scripts/convert_checkpoint.py IN.pth.tar OUT.msgpack
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("src", help="reference .pth.tar checkpoint")
+    p.add_argument("dst", help="output .msgpack path")
+    args = p.parse_args()
+
+    from ncnet_tpu.train.checkpoint import CheckpointData, save_checkpoint
+    from ncnet_tpu.utils.convert_torch import convert_checkpoint
+
+    config, params = convert_checkpoint(args.src)
+    save_checkpoint(args.dst, CheckpointData(config=config, params=params))
+    print(f"wrote {args.dst}")
+    print(f"  config: {config}")
+
+
+if __name__ == "__main__":
+    main()

@@ -48,8 +48,10 @@ def main():
 
     exp = os.path.basename(args.inloc_shortlist).split(".")[0]
     exp += f"_SZ_NEW_{args.image_size}_K_{args.k_size}"
-    exp += "_AtoB" if args.flip_matching_direction else (
-        "_BOTHDIRS" if args.matching_both_directions else "_BtoA"
+    # both_directions takes precedence over flip (reference if/elif order,
+    # eval_inloc.py:61-63)
+    exp += "_BOTHDIRS" if args.matching_both_directions else (
+        "_AtoB" if args.flip_matching_direction else "_BtoA"
     )
     exp += "_SOFTMAX"
     if args.checkpoint:
@@ -69,9 +71,9 @@ def main():
         image_size=args.image_size,
         n_queries=args.n_queries,
         n_panos=args.n_panos,
-        both_directions=args.matching_both_directions
-        and not args.flip_matching_direction,
-        flip_direction=args.flip_matching_direction,
+        both_directions=args.matching_both_directions,
+        flip_direction=args.flip_matching_direction
+        and not args.matching_both_directions,
     )
 
 

@@ -1,0 +1,118 @@
+"""End-to-end learning proof on synthetic data: train the NC head with the
+weak loss on `SyntheticPairDataset` (known cyclic-shift ground truth) and
+report (a) the training-loss curve and (b) a PCK-style keypoint-transfer
+metric before vs after — demonstrating convergence with no dataset on disk.
+
+Runs anywhere (TPU or CPU):
+  python scripts/synthetic_convergence.py [--image_size 128 --steps 200]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def run(image_size=128, steps=200, batch=8, n_pairs=32, lr=5e-4, seed=0,
+        ncons_kernel_sizes=(3, 3), ncons_channels=(16, 1), alpha=0.15,
+        conv4d_impl="cfs", log_every=20, verbose=True):
+    import jax
+
+    from ncnet_tpu.data.loader import DataLoader
+    from ncnet_tpu.data.pairs import SyntheticPairDataset
+    from ncnet_tpu.eval.synthetic import evaluate_synthetic
+    from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+    from ncnet_tpu.train.step import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    config = ImMatchNetConfig(
+        ncons_kernel_sizes=tuple(ncons_kernel_sizes),
+        ncons_channels=tuple(ncons_channels),
+        conv4d_impl=conv4d_impl,
+        # no pretrained weights exist in this environment: centering gives
+        # the random trunk's correlations real contrast (see
+        # feature_extraction_apply docstring)
+        center_features=True,
+    )
+    params = init_immatchnet(jax.random.PRNGKey(seed), config)
+
+    size = (image_size, image_size)
+    train_ds = SyntheticPairDataset(n=n_pairs, output_size=size, seed=seed)
+    eval_ds = SyntheticPairDataset(
+        n=16, output_size=size, seed=seed + 999, return_shift=True
+    )
+    train_loader = DataLoader(
+        train_ds, batch, shuffle=True, seed=seed, num_workers=2, drop_last=True
+    )
+    eval_loader = DataLoader(eval_ds, 8, shuffle=False, num_workers=2)
+
+    pck_before = evaluate_synthetic(params, config, eval_loader, alpha=alpha)
+
+    optimizer = make_optimizer(lr)
+    state = create_train_state(params, optimizer)
+    step_fn = make_train_step(config, optimizer, donate=False)
+
+    losses = []
+    it = iter(train_loader)
+    for i in range(steps):
+        try:
+            batch_np = next(it)
+        except StopIteration:
+            it = iter(train_loader)
+            batch_np = next(it)
+        jb = {
+            "source_image": batch_np["source_image"],
+            "target_image": batch_np["target_image"],
+        }
+        state, loss = step_fn(state, jb)
+        losses.append(float(loss))
+        if verbose and (i + 1) % log_every == 0:
+            print(f"step {i + 1}/{steps} loss {losses[-1]:+.6f}", flush=True)
+
+    pck_after = evaluate_synthetic(state.params, config, eval_loader, alpha=alpha)
+    first = float(np.mean(losses[: max(len(losses) // 10, 1)]))
+    last = float(np.mean(losses[-max(len(losses) // 10, 1):]))
+    if verbose:
+        print(f"loss: first-decile mean {first:+.6f} -> last-decile mean {last:+.6f}")
+        print(f"synthetic transfer PCK@{alpha}: {pck_before:.3f} -> {pck_after:.3f}")
+    return {
+        "loss_first": first,
+        "loss_last": last,
+        "losses": losses,
+        "pck_before": pck_before,
+        "pck_after": pck_after,
+    }
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--image_size", type=int, default=128)
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--lr", type=float, default=5e-4)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--alpha", type=float, default=0.15)
+    p.add_argument("--conv4d_impl", type=str, default="cfs")
+    args = p.parse_args()
+    out = run(
+        image_size=args.image_size,
+        steps=args.steps,
+        batch=args.batch,
+        lr=args.lr,
+        seed=args.seed,
+        alpha=args.alpha,
+        conv4d_impl=args.conv4d_impl,
+    )
+    ok = out["loss_last"] < out["loss_first"] and out["pck_after"] > out["pck_before"]
+    print(f"convergence {'OK' if ok else 'NOT DEMONSTRATED'}")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

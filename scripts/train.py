@@ -38,25 +38,63 @@ def main():
     p.add_argument("--ncons_channels", nargs="+", type=int, default=[16, 16, 1])
     p.add_argument("--fe_arch", type=str, default="resnet101")
     p.add_argument("--train_fe", action="store_true")
+    p.add_argument("--fe_weights", type=str, default="",
+                   help="pretrained trunk weights: reference .pth.tar, raw "
+                        "torchvision state dict (.pth), or ncnet_tpu .msgpack")
+    p.add_argument("--allow_random_fe", action="store_true",
+                   help="explicitly allow a randomly-initialized frozen trunk "
+                        "(the reference always uses ImageNet weights)")
     p.add_argument("--checkpoint", type=str, default="",
-                   help="resume/initialize from a checkpoint")
+                   help="resume/initialize from a checkpoint "
+                        "(.msgpack or reference .pth.tar)")
     p.add_argument("--result_model_dir", type=str, default="trained_models")
     p.add_argument("--result_model_fn", type=str, default="ncnet_tpu.msgpack")
     p.add_argument("--num_workers", type=int, default=4)
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("--bf16", action="store_true", help="bfloat16 compute path")
-    p.add_argument("--conv4d_impl", type=str, default="scan",
-                   choices=["xla", "taps", "scan"])
+    p.add_argument("--conv4d_impl", type=str, default="cf",
+                   choices=["xla", "taps", "scan", "tlc", "tf3", "tf2",
+                            "cf", "cfs"])
     args = p.parse_args()
 
-    start_epoch, opt_state, best_val = 0, None, None
-    if args.checkpoint:
+    if (
+        not args.fe_weights
+        and not args.checkpoint
+        and not args.synthetic
+        and not args.allow_random_fe
+    ):
+        # The reference ALWAYS trains on an ImageNet-pretrained frozen trunk
+        # (lib/model.py:39, pretrained=True); silently training NC over
+        # random-feature correlations looks like it works but learns noise.
+        # Checked before any device/param init so the error is immediate.
+        p.error(
+            "no pretrained trunk: pass --fe_weights (torchvision/reference "
+            "weights) or --checkpoint, or opt in to a random trunk with "
+            "--allow_random_fe"
+        )
+
+    start_epoch, start_step, opt_state, best_val = 0, 0, None, None
+    train_hist = val_hist = None
+    if args.checkpoint and args.checkpoint.endswith((".pth.tar", ".pth")):
+        from ncnet_tpu.utils.convert_torch import convert_checkpoint
+
+        config, params = convert_checkpoint(args.checkpoint)
+        config = config.replace(
+            half_precision=args.bf16, conv4d_impl=args.conv4d_impl,
+            nc_remat=True,
+        )
+        print(f"initialized from reference checkpoint {args.checkpoint} "
+              "(weights-only: torch optimizer state is not portable)")
+    elif args.checkpoint:
         ck = load_checkpoint(args.checkpoint)
         config, params = ck.config, ck.params
         start_epoch = ck.epoch
+        start_step = ck.step
         opt_state = ck.opt_state  # raw state dict; train() restores into shape
         best_val = ck.best_val_loss
-        print(f"resuming from {args.checkpoint} at epoch {start_epoch}")
+        train_hist, val_hist = ck.train_loss, ck.val_loss
+        print(f"resuming from {args.checkpoint} at epoch {start_epoch} "
+              f"(step {start_step})")
         print(f"  config: {config}")
     else:
         config = ImMatchNetConfig(
@@ -68,6 +106,15 @@ def main():
             nc_remat=True,
         )
         params = init_immatchnet(jax.random.PRNGKey(args.seed), config)
+
+    if args.fe_weights:
+        from ncnet_tpu.utils.convert_torch import load_trunk_weights
+
+        params = dict(params)
+        params["feature_extraction"] = load_trunk_weights(
+            args.fe_weights, cnn=config.feature_extraction_cnn
+        )
+        print(f"loaded trunk weights from {args.fe_weights}")
 
     size = (args.image_size, args.image_size)
     if args.synthetic:
@@ -102,8 +149,11 @@ def main():
         checkpoint_dir=args.result_model_dir,
         checkpoint_name=args.result_model_fn,
         start_epoch=start_epoch,
+        start_step=start_step,
         opt_state=opt_state,
         initial_best_val=best_val,
+        initial_train_hist=train_hist,
+        initial_val_hist=val_hist,
     )
 
 

@@ -24,7 +24,9 @@ def conv4d_bruteforce(x, w, bias=None):
     return out
 
 
-@pytest.mark.parametrize("impl", ["xla", "taps"])
+@pytest.mark.parametrize(
+    "impl", ["xla", "taps", "scan", "tlc", "tf3", "tf2", "cf", "cfs"]
+)
 @pytest.mark.parametrize("ksize,cin,cout", [(3, 1, 2), (5, 2, 1)])
 def test_conv4d_matches_bruteforce(impl, ksize, cin, cout):
     rng = np.random.RandomState(0)
@@ -36,20 +38,22 @@ def test_conv4d_matches_bruteforce(impl, ksize, cin, cout):
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
 
 
-def test_conv4d_impls_agree_with_grad():
+@pytest.mark.parametrize("impl", ["taps", "scan", "tlc", "tf3", "tf2", "cf", "cfs"])
+def test_conv4d_impls_agree_with_grad(impl):
     rng = np.random.RandomState(1)
     x = jnp.asarray(rng.randn(1, 4, 4, 4, 4, 2).astype(np.float32))
     w = jnp.asarray(rng.randn(3, 3, 3, 3, 2, 2).astype(np.float32))
     b = jnp.asarray(rng.randn(2).astype(np.float32))
 
-    f_xla = lambda w_: jnp.sum(jnp.sin(conv4d(x, w_, b, impl="xla")))
-    f_taps = lambda w_: jnp.sum(jnp.sin(conv4d(x, w_, b, impl="taps")))
-    np.testing.assert_allclose(f_xla(w), f_taps(w), rtol=1e-5)
-    g_xla = jax.grad(f_xla)(w)
-    g_taps = jax.grad(f_taps)(w)
-    np.testing.assert_allclose(
-        np.asarray(g_xla), np.asarray(g_taps), rtol=1e-3, atol=1e-4
-    )
+    f_xla = lambda x_, w_, b_: jnp.sum(jnp.sin(conv4d(x_, w_, b_, impl="xla")))
+    f_imp = lambda x_, w_, b_: jnp.sum(jnp.sin(conv4d(x_, w_, b_, impl=impl)))
+    np.testing.assert_allclose(f_xla(x, w, b), f_imp(x, w, b), rtol=1e-5)
+    g_xla = jax.grad(f_xla, argnums=(0, 1, 2))(x, w, b)
+    g_imp = jax.grad(f_imp, argnums=(0, 1, 2))(x, w, b)
+    for a, bgrad in zip(g_xla, g_imp):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(bgrad), rtol=1e-3, atol=1e-4
+        )
 
 
 def test_conv4d_matches_torch_conv3d_decomposition():

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Released reference checkpoints (torch .pth.tar). Convert for ncnet_tpu
+# with:
+#   python scripts/convert_checkpoint.py trained_models/ncnet_pfpascal.pth.tar \
+#       trained_models/ncnet_pfpascal.msgpack
+# or pass the .pth.tar directly to the eval/train CLIs, which convert
+# on the fly (scripts/eval_pf_pascal.py, scripts/train.py --checkpoint).
+set -euo pipefail
+cd "$(dirname "$0")"
+wget -nc https://www.di.ens.fr/willow/research/ncnet/models/ncnet_pfpascal.pth.tar
+wget -nc https://www.di.ens.fr/willow/research/ncnet/models/ncnet_ivd.pth.tar
+# ImageNet trunk weights (torchvision); any of these works for --fe_weights:
+wget -nc https://download.pytorch.org/models/resnet101-63fe2227.pth
+wget -nc https://download.pytorch.org/models/vgg16-397923af.pth
