@@ -16,10 +16,8 @@ corr [16, 25, 25, 25, 25], NC layer 2: 5^4 kernel, 16 -> 16 channels
 """
 
 import argparse
-import functools
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -28,28 +26,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 
-
-def _time_once(fn, *args):
-    out = fn(*args)
-    leaves = jax.tree_util.tree_leaves(out)
-    t0 = time.perf_counter()
-    float(jnp.sum(leaves[0]))
-    return time.perf_counter() - t0
-
-
-def time_chain(make_chain, n_lo=1, n_hi=6, iters=3):
-    """Per-iteration seconds via the (n_hi - n_lo) slope.
-
-    ``make_chain(n)`` must return ``(jitted_fn, args)`` running the op n
-    times with data dependencies between repeats.
-    """
-    results = {}
-    for n in (n_lo, n_hi):
-        fn, args = make_chain(n)
-        fn(*args)  # compile
-        _time_once(fn, *args)  # warmup
-        results[n] = min(_time_once(fn, *args) for _ in range(iters))
-    return (results[n_hi] - results[n_lo]) / (n_hi - n_lo)
+from timing import time_chain
 
 
 def main():

@@ -17,24 +17,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 
-
-def _sync(out):
-    float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
-
-
-def time_chain(make_chain, n_lo=1, n_hi=5, iters=3):
-    res = {}
-    for n in (n_lo, n_hi):
-        fn, args = make_chain(n)
-        _sync(fn(*args))
-        _sync(fn(*args))
-        ts = []
-        for _ in range(iters):
-            t0 = time.perf_counter()
-            _sync(fn(*args))
-            ts.append(time.perf_counter() - t0)
-        res[n] = min(ts)
-    return (res[n_hi] - res[n_lo]) / (n_hi - n_lo)
+from timing import sync as _sync
+from timing import time_chain
 
 
 def main():
@@ -81,12 +65,15 @@ def main():
     def mk_feat(n):
         @jax.jit
         def f(p, x):
+            # accumulate so no iteration is dead code (an overwritten
+            # `out` lets XLA DCE all but the last repeat)
+            acc = 0.0
             y = x
-            out = None
             for _ in range(n):
-                out = extract_features(p, config, y)
+                feat = extract_features(p, config, y)
+                acc = acc + jnp.sum(feat.astype(jnp.float32))
                 y = y + 1e-6
-            return out
+            return acc
 
         return f, (params, imgs)
 
@@ -102,12 +89,13 @@ def main():
     def mk_pipe(n):
         @jax.jit
         def f(nc, fa_, fb_):
-            out = None
+            acc = 0.0
             x = fa_
             for _ in range(n):
                 out = match_pipeline(nc, config, x, fb_)
+                acc = acc + jnp.sum(out.astype(jnp.float32))
                 x = x + 1e-6
-            return out
+            return acc
 
         return f, (params["neigh_consensus"], fa, fb)
 
@@ -134,8 +122,6 @@ def main():
     step = make_train_step(config, optimizer, donate=False)
     state, loss = step(state, batch)
     _sync(loss)
-    for n in (1, 5):
-        pass
     ts = {}
     for n in (1, 5):
         t0 = time.perf_counter()

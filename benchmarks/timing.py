@@ -1,0 +1,44 @@
+"""Shared honest-timing helpers for the microbenchmarks.
+
+Platform facts (measured, rounds 1-2): ``jax.block_until_ready`` does NOT
+block on the tunneled axon platform — only a device-to-host transfer forces
+execution — and a D2H roundtrip costs ~75-95 ms, which swamps per-op
+timings. So: every sync is a D2H reduction, and per-op costs come from the
+SLOPE between a short and a long chain of dependent applications inside one
+jit (the sync constant and dispatch overheads cancel).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def sync(out):
+    """Force execution of ``out`` via a device-to-host reduction."""
+    return float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+
+
+def time_once(fn, *args):
+    """Seconds for one synced call (includes the D2H constant)."""
+    out = fn(*args)
+    t0 = time.perf_counter()
+    sync(out)
+    return time.perf_counter() - t0
+
+
+def time_chain(make_chain, n_lo=1, n_hi=6, iters=3):
+    """Per-iteration seconds via the (n_hi - n_lo) slope.
+
+    ``make_chain(n)`` must return ``(jitted_fn, args)`` running the op n
+    times with data dependencies between repeats — beware XLA DCE: every
+    repeat must contribute to the returned value (accumulate, don't
+    overwrite).
+    """
+    results = {}
+    for n in (n_lo, n_hi):
+        fn, args = make_chain(n)
+        fn(*args)  # compile
+        time_once(fn, *args)  # warmup
+        results[n] = min(time_once(fn, *args) for _ in range(iters))
+    return (results[n_hi] - results[n_lo]) / (n_hi - n_lo)

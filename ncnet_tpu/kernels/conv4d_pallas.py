@@ -19,6 +19,17 @@ The backward pass is a custom VJP: dx reuses this kernel with
 spatially-flipped, channel-transposed filters (a 4D convolution identity);
 dw runs a second kernel that contracts the same patches against the
 incoming cotangent per tap-triple.
+
+STATUS (round 2, measured on v5e): the kernel is numerically verified in
+interpret mode (forward + full VJP, tests/test_conv4d.py) but does NOT
+lower through Mosaic on current libtpu — the in-kernel ``[J, K*L*C] ->
+[J, K, L, C]`` reshape fails layout inference ("unsupported shape cast").
+More importantly, the design cannot win on this op: with <=16 output
+channels every patch-GEMM formulation is capped at 16/128 MXU lanes, and
+the lane-widening tap-folding tricks (`ops.conv4d` impls 'cf'/'cfs', 20-30
+TFLOP/s measured f+b) are exactly what XLA's conv already compiles well.
+Kept as the interpret-verified scaffold for a future kernel where fusion
+wins (e.g. conv4d+ReLU+MutualMatching in one pass).
 """
 
 import functools
@@ -58,18 +69,17 @@ def _fwd_kernel(x_hbm, w_ref, b_ref, out_ref, slab, acc, sem, *, shapes):
             xp = jnp.pad(xv, ((P, P), (P, P), (P, P), (0, 0)))
 
             for dj in range(KJ):
-                xj = jax.lax.dynamic_slice_in_dim(xp, dj, J, axis=0)
+                # static-index slices (lax.dynamic_slice is not lowerable
+                # inside TPU Pallas kernels; these indices are Python ints)
+                xj = xp[dj : dj + J]
                 # build the (dl, c) window columns once per (di, dj):
                 # pbig[j, k', l, (dl, c)] = xj[j, k', l + dl, c]
                 pbig = jnp.concatenate(
-                    [
-                        jax.lax.dynamic_slice_in_dim(xj, dl, L, axis=2)
-                        for dl in range(KL)
-                    ],
+                    [xj[:, :, dl : dl + L] for dl in range(KL)],
                     axis=3,
                 )  # [J, K+2P, L, KL*C]
                 for dk in range(KK):
-                    patch = jax.lax.dynamic_slice_in_dim(pbig, dk, K, axis=1)
+                    patch = pbig[:, dk : dk + K]
                     pm = patch.reshape(J * K * L, KL * C)
                     t = (di * KJ + dj) * KK + dk
                     wt = w_ref[pl.ds(t * KL * C, KL * C), :]  # [KL*C, O]
@@ -93,7 +103,7 @@ def _conv4d_packed_pallas_fwd(xp, w2, bias, kl_shape, cin, cout, interpret=False
         kernel,
         grid=(B, I),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.ANY),  # x stays in HBM, DMA'd
+            pl.BlockSpec(memory_space=pl.ANY),  # x stays in HBM, DMA'd
             pl.BlockSpec(memory_space=pltpu.VMEM),  # flattened weights
             pl.BlockSpec(memory_space=pltpu.VMEM),  # bias row
         ],

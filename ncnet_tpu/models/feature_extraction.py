@@ -56,8 +56,6 @@ def feature_extraction_apply(
         image = image.astype(dtype)
     feats = apply_fn(params, image)
     if center:
-        import jax.numpy as jnp
-
         feats = feats - jnp.mean(feats, axis=(1, 2), keepdims=True)
     if normalize:
         feats = feature_l2norm(feats, axis=-1)

@@ -48,6 +48,11 @@ class ImMatchNetConfig:
     # size in the training loss (0 = whole batch): bounds the live 4D
     # tensors to the chunk, enabling the wide-lane conv4d impls at batch 16.
     loss_chunk: int = 0
+    # Rematerialize each loss chunk's pipeline in the backward pass. On by
+    # default: without it, `lax.map` stacks every chunk's forward residuals
+    # for the backward pass, so peak memory scales with the full batch
+    # again (measured OOM at batch 16 / chunk 8 on a 16G v5e).
+    loss_chunk_remat: bool = True
     # Subtract the per-image spatial feature mean before L2-norm (framework
     # extension, off = reference semantics; see feature_extraction_apply).
     center_features: bool = False

@@ -11,7 +11,11 @@ from ncnet_tpu.ops.coords import (
     points_to_unit_coords,
     unnormalize_axis,
 )
-from ncnet_tpu.ops.correlation import correlation_4d, correlation_maxpool4d
+from ncnet_tpu.ops.correlation import (
+    correlation_3d,
+    correlation_4d,
+    correlation_maxpool4d,
+)
 from ncnet_tpu.ops.image import imagenet_normalize, resize_bilinear_align_corners
 from ncnet_tpu.ops.matches import (
     bilinear_point_transfer,
@@ -24,6 +28,7 @@ from ncnet_tpu.ops.norm import feature_l2norm
 
 __all__ = [
     "conv4d",
+    "correlation_3d",
     "correlation_4d",
     "correlation_maxpool4d",
     "corr_to_matches",

@@ -25,6 +25,7 @@ Implementations:
     6D temps 4-5x (see bench notes).
 """
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -153,7 +154,7 @@ def _conv4d_scan(x, w):
     return jnp.moveaxis(out, 0, 1)
 
 
-def conv4d_packed(xp, w, kl_shape, bias=None, impl="scan"):
+def conv4d_packed(xp, w, kl_shape, bias=None, impl="scan", interpret=None):
     """4D convolution on the fused layout ``[b, i, j, k*l*c]`` (c fastest).
 
     TPU memory-layout native: the channels-minor 6D activation layout pads
@@ -175,10 +176,40 @@ def conv4d_packed(xp, w, kl_shape, bias=None, impl="scan"):
         directly on the packed layout below) or any `conv4d` impl name
         ('tlc', 'tf3', ... — fastest at small grids), routed through a pure
         unpack -> conv4d -> repack; all consume/produce the packed layout.
+      interpret: for impl='pallas' only — run the kernel in the Pallas
+        interpreter (None = auto: interpret unless the default backend is
+        TPU; pass explicitly when tracing for a non-default device).
 
     Returns:
       ``[b, i, j, k*l*c_out]``.
     """
+    if impl == "pallas":
+        from ncnet_tpu.kernels.conv4d_pallas import conv4d_packed_pallas
+
+        k, l = kl_shape
+        cin, cout = w.shape[-2], w.shape[-1]
+        assert k * l * cin == xp.shape[-1], (kl_shape, cin, xp.shape)
+        b = jnp.zeros((cout,), jnp.float32) if bias is None else bias
+        # Interpret mode runs the kernel in the Pallas interpreter so the
+        # CPU test mesh exercises the exact same code path as the TPU.
+        # Default follows the backend; override with interpret=True/False
+        # when tracing for a device that differs from the default backend.
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        if not interpret:
+            # Honest guard: the kernel's in-kernel 4D reshape does not pass
+            # Mosaic layout inference on current libtpu ("unsupported shape
+            # cast"), and a lowerable redesign cannot beat the XLA tap-
+            # folding impls anyway (<=16 output channels caps any direct
+            # patch GEMM at 16/128 MXU lanes — see kernels/conv4d_pallas.py).
+            raise NotImplementedError(
+                "conv4d impl='pallas' currently lowers only in interpret "
+                "mode (pass interpret=True); on TPU use impl='cf'/'cfs' "
+                "(the fastest measured formulations)"
+            )
+        return conv4d_packed_pallas(
+            xp, w, b, kl_shape, cin, cout, interpret
+        )
     if impl != "scan":
         b, i, j, fused = xp.shape
         k, l = kl_shape
@@ -373,6 +404,104 @@ def _conv4d_cfs(x, w):
     return jnp.moveaxis(out, 0, 1)
 
 
+def _gemm_kernel(w):
+    """[ki,kj,kk,kl,cin,cout] -> [(di, dl, c) rows, (dj, dk, o) cols]."""
+    ki, kj, kk, kl, cin, cout = w.shape
+    return w.transpose(0, 3, 4, 1, 2, 5).reshape(
+        ki * kl * cin, kj * kk * cout
+    )
+
+
+def _gemm_epilogue(y, j, k, kj, kk, cout):
+    """Shift-sum the (dj, dk) output-channel blocks of ``y`` over (j, k).
+
+    ``y``: [..., j, k, l, kj*kk*cout] with block t = dj*kk + dk holding that
+    tap pair's contribution. Channel blocks are sliced on the FUSED trailing
+    dim (a trailing (kj, kk, cout) split would tile terribly on TPU).
+    """
+    pj, pk = kj // 2, kk // 2
+    nb = y.ndim - 4  # leading batch-like dims
+    ypad = jnp.pad(
+        y, ((0, 0),) * nb + ((pj, pj), (pk, pk), (0, 0), (0, 0))
+    )
+    out = None
+    ix = (slice(None),) * nb
+    for dj in range(kj):
+        for dk in range(kk):
+            t = dj * kk + dk
+            term = ypad[
+                ix
+                + (
+                    slice(dj, dj + j),
+                    slice(dk, dk + k),
+                    slice(None),
+                    slice(t * cout, (t + 1) * cout),
+                )
+            ]
+            out = term if out is None else out + term
+    return out
+
+
+def _conv4d_gemm(x, w):
+    """conv4d as ONE MXU GEMM: (di, dl) taps gathered into the contraction
+    dim, (dj, dk) taps folded into output channels.
+
+    K = ki*kl*cin and N = kj*kk*cout (400 at the PF-Pascal config — full
+    128-lane MXU tiles with zero FLOP inflation; every narrower direct
+    lowering measured <=30 TFLOP/s on v5e while a wide-lane conv ran at
+    >130). M = b*i*j*k*l. The input-side gather materializes ki*kl shifted
+    copies (bounded by the caller's loss chunking); the epilogue is the
+    cheap (dj, dk) shift-sum.
+    """
+    b, i, j, k, l, cin = x.shape
+    ki, kj, kk, kl, _, cout = w.shape
+    pi, pl_ = ki // 2, kl // 2
+    xpad = jnp.pad(x, ((0, 0), (pi, pi), (0, 0), (0, 0), (pl_, pl_), (0, 0)))
+    cols = jnp.concatenate(
+        [
+            xpad[:, di : di + i, :, :, dl : dl + l, :]
+            for di in range(ki)
+            for dl in range(kl)
+        ],
+        axis=-1,
+    )  # [b, i, j, k, l, ki*kl*cin]
+    y = jnp.einsum(
+        "bijklK,KN->bijklN",
+        cols,
+        _gemm_kernel(w).astype(x.dtype),
+        preferred_element_type=x.dtype,
+    )
+    return _gemm_epilogue(y, j, k, kj, kk, cout)
+
+
+def _conv4d_gemms(x, w):
+    """`_conv4d_gemm` as a `lax.scan` over the leading spatial dim:
+    O(1/I) live memory for the gathered columns and tap outputs."""
+    b, i, j, k, l, cin = x.shape
+    ki, kj, kk, kl, _, cout = w.shape
+    pi, pl_ = ki // 2, kl // 2
+    xpad = jnp.pad(x, ((0, 0), (pi, pi), (0, 0), (0, 0), (pl_, pl_), (0, 0)))
+    w2 = _gemm_kernel(w).astype(x.dtype)
+
+    def slice_out(_, out_i):
+        window = lax.dynamic_slice_in_dim(xpad, out_i, ki, axis=1)
+        cols = jnp.concatenate(
+            [
+                window[:, di, :, :, dl : dl + l, :]
+                for di in range(ki)
+                for dl in range(kl)
+            ],
+            axis=-1,
+        )  # [b, j, k, l, ki*kl*cin]
+        y = jnp.einsum(
+            "bjklK,KN->bjklN", cols, w2, preferred_element_type=x.dtype
+        )
+        return None, _gemm_epilogue(y, j, k, kj, kk, cout)
+
+    _, out = lax.scan(slice_out, None, jnp.arange(i))
+    return jnp.moveaxis(out, 0, 1)
+
+
 def conv4d(x, w, bias=None, impl="xla"):
     """SAME, stride-1 4D convolution.
 
@@ -386,11 +515,24 @@ def conv4d(x, w, bias=None, impl="xla"):
         conv3d, 5x FLOPs but wide lanes) | 'tf3'/'tf2' (taps folded into
         output channels + shift-sum) | 'cf'/'cfs' (taps folded into BOTH
         input and output channels of one conv2d — true FLOPs, wide lanes
-        both directions; 'cfs' is the scanned low-memory variant).
+        both directions; 'cfs' is the scanned low-memory variant) |
+        'gemm'/'gemms' ((di, dl) taps gathered into the contraction dim,
+        (dj, dk) into output channels: ONE full-lane MXU GEMM, true FLOPs;
+        'gemms' is the scanned low-memory variant) |
+        'pallas' (hand-written TPU kernel on the packed layout,
+        kernels/conv4d_pallas.py; hypercubic kernels only).
 
     Returns:
       ``[b, i, j, k, l, c_out]``.
     """
+    if impl == "pallas":
+        b, i, j, k, l, cin = x.shape
+        cout = w.shape[-1]
+        out = conv4d_packed(
+            x.reshape(b, i, j, k * l * cin), w, (k, l), bias=bias,
+            impl="pallas",
+        )
+        return out.reshape(b, i, j, k, l, cout)
     if impl == "xla":
         out = _conv4d_xla(x, w)
     elif impl == "taps":
@@ -407,6 +549,10 @@ def conv4d(x, w, bias=None, impl="xla"):
         out = _conv4d_cf(x, w)
     elif impl == "cfs":
         out = _conv4d_cfs(x, w)
+    elif impl == "gemm":
+        out = _conv4d_gemm(x, w)
+    elif impl == "gemms":
+        out = _conv4d_gemms(x, w)
     else:
         raise ValueError(f"unknown conv4d impl: {impl!r}")
     if bias is not None:

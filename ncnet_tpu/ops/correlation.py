@@ -45,6 +45,38 @@ def correlation_4d(feature_a, feature_b, normalization=False, relu=True):
     return corr
 
 
+def correlation_3d(feature_a, feature_b, normalization=True, relu=True):
+    """3D-shaped correlation: A's grid flattened into a channel axis.
+
+    Reference ``FeatureCorrelation(shape='3D')`` (lib/model.py:97-105) —
+    used by geometric-matching models built on the same module (not by
+    ImMatchNet, which uses the 4D shape); part of the reference's exported
+    surface. Output is channels-last ``[b, hB, wB, hA*wA]`` with channel
+    index ``idx_A = iA + hA * jA`` (column-major over A's grid), matching
+    the reference's ``[b, idx_A, hB, wB]`` tensor up to the NHWC layout.
+
+    Args:
+      feature_a, feature_b: ``[b, h, w, c]`` feature maps (same grid —
+        the reference's 3D branch assumes matching shapes).
+      normalization: reference default True — ReLU then per-location L2
+        normalization over the flattened-A channel axis.
+      relu: only used when ``normalization`` is True.
+    """
+    b, h, w, _ = feature_a.shape
+    corr = jnp.einsum(
+        "bijc,bklc->bklji",
+        feature_a,
+        feature_b,
+        preferred_element_type=feature_a.dtype,
+    )  # [b, iB, jB, jA, iA]: (jA, iA) row-major flattens to iA + h*jA
+    corr = corr.reshape(b, h, w, h * w)
+    if normalization:
+        if relu:
+            corr = jax.nn.relu(corr)
+        corr = feature_l2norm(corr, axis=-1)
+    return corr
+
+
 def correlation_maxpool4d(feature_a, feature_b, k_size):
     """Fused correlation + 4D max-pool ("relocalization"), HBM-friendly.
 

@@ -54,10 +54,12 @@ def weak_loss(params, config, batch, normalization="softmax"):
 
     When ``config.loss_chunk`` > 0 the post-backbone pipeline (correlation
     -> MM -> NC -> MM -> score) runs over sample chunks of that size via
-    `lax.map` with rematerialization per chunk: peak memory for the big 4D
-    tensors scales with the chunk, not the batch. Identical math — the
-    rolled-negative pairing is fixed on the full batch of features BEFORE
-    chunking, and all scores are per-sample means.
+    `lax.map`, rematerialized per chunk when ``config.loss_chunk_remat``
+    (default True): peak memory for the big 4D tensors then scales with
+    the chunk, not the batch (with it off, `lax.map` stacks residuals
+    across chunks and memory scales with the batch again). Identical
+    math — the rolled-negative pairing is fixed on the full batch of
+    features BEFORE chunking, and all scores are per-sample means.
     """
     if config.relocalization_k_size > 1:
         raise ValueError(
@@ -89,9 +91,10 @@ def weak_loss(params, config, batch, normalization="softmax"):
             feat_b.reshape(shape),
             feat_a_neg.reshape(shape),
         )
-        pos, neg = lax.map(
-            jax.checkpoint(lambda t: pair_scores(*t)), chunks
-        )
+        chunk_fn = lambda t: pair_scores(*t)
+        if getattr(config, "loss_chunk_remat", True):
+            chunk_fn = jax.checkpoint(chunk_fn)
+        pos, neg = lax.map(chunk_fn, chunks)
         score_pos, score_neg = jnp.mean(pos), jnp.mean(neg)
     else:
         pos, neg = pair_scores(feat_a, feat_b, feat_a_neg)

@@ -1,0 +1,162 @@
+"""Keypoint-transfer demo — the reference ``point_transfer_demo.ipynb``
+(cells 1-7) as a script: load a model, pick a PF-Pascal test pair, forward,
+``corr_to_matches(do_softmax=True)`` -> bilinear keypoint transfer -> save a
+side-by-side PNG a human can eyeball (via ncnet_tpu.utils.plot, the
+lib/plot.py equivalent).
+
+With no dataset on disk (zero-egress environments), ``--synthetic`` runs the
+same pipeline on a generated pair with KNOWN cyclic-shift ground truth and
+reports the transfer PCK in the figure title.
+
+Example:
+  python scripts/demo_point_transfer.py --checkpoint trained_models/ncnet_tpu.msgpack
+  python scripts/demo_point_transfer.py --synthetic --out demo.png
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    p = argparse.ArgumentParser(description="ncnet_tpu point-transfer demo")
+    p.add_argument("--checkpoint", type=str, default="",
+                   help=".msgpack or reference .pth.tar checkpoint "
+                        "(random weights if omitted)")
+    p.add_argument("--dataset_image_path", type=str, default="datasets/pf-pascal")
+    p.add_argument("--dataset_csv_path", type=str,
+                   default="datasets/pf-pascal/image_pairs")
+    p.add_argument("--pair_idx", type=int, default=-1,
+                   help="test-pair index (-1 = random, like the notebook)")
+    p.add_argument("--synthetic", action="store_true",
+                   help="use a generated pair with known ground truth")
+    p.add_argument("--image_size", type=int, default=400)
+    p.add_argument("--out", type=str, default="demo_point_transfer.png")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    from ncnet_tpu.models.immatchnet import (
+        ImMatchNetConfig,
+        immatchnet_apply,
+        init_immatchnet,
+    )
+    from ncnet_tpu.ops.coords import (
+        points_to_pixel_coords,
+        points_to_unit_coords,
+    )
+    from ncnet_tpu.ops.matches import bilinear_point_transfer, corr_to_matches
+    from ncnet_tpu.utils.plot import draw_point_transfer
+
+    if args.checkpoint.endswith((".pth.tar", ".pth")):
+        from ncnet_tpu.utils.convert_torch import convert_checkpoint
+
+        config, params = convert_checkpoint(args.checkpoint)
+    elif args.checkpoint:
+        from ncnet_tpu.train.checkpoint import load_checkpoint
+
+        ck = load_checkpoint(args.checkpoint)
+        config, params = ck.config, ck.params
+    else:
+        print("WARNING: no --checkpoint — using RANDOM weights; the transfer "
+              "will be noise (this exercises the pipeline, not the model)")
+        config = ImMatchNetConfig(
+            ncons_kernel_sizes=(5, 5, 5), ncons_channels=(16, 16, 1),
+            conv4d_impl="cf",
+        )
+        params = init_immatchnet(jax.random.PRNGKey(args.seed), config)
+
+    size = (args.image_size, args.image_size)
+    title = None
+    if args.synthetic:
+        from ncnet_tpu.data.pairs import SyntheticPairDataset
+        from ncnet_tpu.eval.synthetic import _query_grid
+
+        ds = SyntheticPairDataset(
+            n=8, output_size=size, seed=args.seed, return_shift=True
+        )
+        idx = (
+            np.random.RandomState(args.seed).randint(len(ds))
+            if args.pair_idx < 0
+            else args.pair_idx
+        )
+        sample = ds[idx]
+        h, w = size
+        tgt_px = _query_grid(h, w)  # [2, 16] in the right half (no wrap)
+        gt_src_px = tgt_px.copy()
+        gt_src_px[0] -= float(sample["shift"])
+        src_pts, tgt_pts = gt_src_px, tgt_px
+        im_size = np.asarray([[h, w, 3]], np.float32)
+        src_size = tgt_size = im_size
+    else:
+        from ncnet_tpu.data.pairs import PFPascalDataset
+
+        csv = os.path.join(args.dataset_csv_path, "test_pairs.csv")
+        ds = PFPascalDataset(
+            csv, args.dataset_image_path, output_size=size, pck_procedure="pf"
+        )
+        idx = (
+            np.random.RandomState(args.seed).randint(len(ds))
+            if args.pair_idx < 0
+            else args.pair_idx
+        )
+        sample = ds[idx]
+        src_pts = np.asarray(sample["source_points"])
+        tgt_pts = np.asarray(sample["target_points"])
+        src_size = np.asarray(sample["source_im_size"], np.float32)[None]
+        tgt_size = np.asarray(sample["target_im_size"], np.float32)[None]
+
+    src = jnp.asarray(sample["source_image"])[None]
+    tgt = jnp.asarray(sample["target_image"])[None]
+    print(f"pair {idx}: forward on {jax.default_backend()} ...", flush=True)
+    corr = immatchnet_apply(params, config, src, tgt)
+    x_a, y_a, x_b, y_b, _ = corr_to_matches(corr, do_softmax=True)
+
+    tgt_norm = points_to_unit_coords(
+        jnp.asarray(tgt_pts)[None], jnp.asarray(tgt_size)
+    )
+    warped_norm = bilinear_point_transfer((x_a, y_a, x_b, y_b), tgt_norm)
+    warped_px = np.asarray(
+        points_to_pixel_coords(warped_norm, jnp.asarray(src_size))
+    )[0]
+
+    if args.synthetic:
+        valid = src_pts[0] != -1
+        err = np.linalg.norm(warped_px[:, valid] - src_pts[:, valid], axis=0)
+        pck = float((err <= 0.1 * args.image_size).mean())
+        title = (
+            f"synthetic pair {idx} (shift={int(sample['shift'])}px): "
+            f"transfer PCK@0.1 = {pck:.2f}"
+        )
+        print(title)
+
+    # Points are in ORIGINAL image pixels; the displayed images are resized
+    # to `size`, so scale points into the displayed frame.
+    def to_display(pts, im_size):
+        s = np.asarray(
+            [size[1] / im_size[0, 1], size[0] / im_size[0, 0]], np.float32
+        )
+        out = pts * s[:, None]
+        out[:, pts[0] == -1] = -1
+        return out
+
+    out_path = draw_point_transfer(
+        np.asarray(src[0]),
+        np.asarray(tgt[0]),
+        to_display(src_pts, src_size),
+        to_display(warped_px, src_size),
+        to_display(tgt_pts, tgt_size),
+        args.out,
+        title=title,
+    )
+    print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -54,7 +54,7 @@ def main():
     p.add_argument("--bf16", action="store_true", help="bfloat16 compute path")
     p.add_argument("--conv4d_impl", type=str, default="cf",
                    choices=["xla", "taps", "scan", "tlc", "tf3", "tf2",
-                            "cf", "cfs"])
+                            "cf", "cfs", "gemm", "gemms", "pallas"])
     args = p.parse_args()
 
     if (
@@ -78,7 +78,17 @@ def main():
     if args.checkpoint and args.checkpoint.endswith((".pth.tar", ".pth")):
         from ncnet_tpu.utils.convert_torch import convert_checkpoint
 
-        config, params = convert_checkpoint(args.checkpoint)
+        try:
+            config, params = convert_checkpoint(args.checkpoint)
+        except (KeyError, AttributeError) as e:
+            # A raw torchvision state dict (trunk-only weights) has no
+            # 'state_dict'/'args'/'NeighConsensus' entries — that file
+            # belongs to --fe_weights, not --checkpoint.
+            p.error(
+                f"{args.checkpoint} is not a full reference training "
+                f"checkpoint ({type(e).__name__}: {e}); for trunk-only "
+                "weights (e.g. a raw torchvision .pth) use --fe_weights"
+            )
         config = config.replace(
             half_precision=args.bf16, conv4d_impl=args.conv4d_impl,
             nc_remat=True,

@@ -25,7 +25,9 @@ def conv4d_bruteforce(x, w, bias=None):
 
 
 @pytest.mark.parametrize(
-    "impl", ["xla", "taps", "scan", "tlc", "tf3", "tf2", "cf", "cfs"]
+    "impl",
+    ["xla", "taps", "scan", "tlc", "tf3", "tf2", "cf", "cfs", "gemm",
+     "gemms", "pallas"],
 )
 @pytest.mark.parametrize("ksize,cin,cout", [(3, 1, 2), (5, 2, 1)])
 def test_conv4d_matches_bruteforce(impl, ksize, cin, cout):
@@ -38,7 +40,11 @@ def test_conv4d_matches_bruteforce(impl, ksize, cin, cout):
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
 
 
-@pytest.mark.parametrize("impl", ["taps", "scan", "tlc", "tf3", "tf2", "cf", "cfs"])
+@pytest.mark.parametrize(
+    "impl",
+    ["taps", "scan", "tlc", "tf3", "tf2", "cf", "cfs", "gemm", "gemms",
+     "pallas"],
+)
 def test_conv4d_impls_agree_with_grad(impl):
     rng = np.random.RandomState(1)
     x = jnp.asarray(rng.randn(1, 4, 4, 4, 4, 2).astype(np.float32))

@@ -2,7 +2,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from ncnet_tpu.ops.correlation import correlation_4d, correlation_maxpool4d
+from ncnet_tpu.ops.correlation import (
+    correlation_3d,
+    correlation_4d,
+    correlation_maxpool4d,
+)
 from ncnet_tpu.ops.matching import maxpool4d, mutual_matching
 from ncnet_tpu.ops.norm import feature_l2norm
 
@@ -26,6 +30,31 @@ def test_correlation_4d_normalized_branch():
     raw = np.maximum(np.einsum("bijc,bklc->bijkl", fa, fb), 0)
     flat = raw.reshape(1, 3, 3, 9)
     want = (flat / np.sqrt((flat**2).sum(-1, keepdims=True) + 1e-6)).reshape(raw.shape)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_correlation_3d_matches_torch_reference():
+    """Parity with the reference's shape='3D' branch (lib/model.py:97-105):
+    bmm of a column-major-flattened A against B, ReLU + L2 norm."""
+    torch = pytest.importorskip("torch")
+
+    rng = np.random.RandomState(3)
+    b, h, w, c = 2, 3, 4, 5
+    fa = rng.randn(b, h, w, c).astype(np.float32)
+    fb = rng.randn(b, h, w, c).astype(np.float32)
+
+    # torch reference math on NCHW tensors
+    ta = torch.from_numpy(fa.transpose(0, 3, 1, 2))
+    tb = torch.from_numpy(fb.transpose(0, 3, 1, 2))
+    fa_t = ta.transpose(2, 3).contiguous().view(b, c, h * w)
+    fb_t = tb.reshape(b, c, h * w).transpose(1, 2)
+    mul = torch.bmm(fb_t, fa_t)
+    ref = mul.view(b, h, w, h * w).transpose(2, 3).transpose(1, 2)
+    ref = torch.relu(ref)
+    ref = ref / (ref.pow(2).sum(1, keepdim=True) + 1e-6).sqrt()
+    want = ref.numpy().transpose(0, 2, 3, 1)  # -> [b, hB, wB, hA*wA]
+
+    got = np.asarray(correlation_3d(jnp.asarray(fa), jnp.asarray(fb)))
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
 
