@@ -48,6 +48,12 @@ def main():
         ("conv2d 10000x25^2 16->512 k5", 10000, (25, 25), 16, 512, 5),
         ("conv2d 2500x50^2 16->400 k5", 2500, (50, 50), 16, 400, 5),
         ("conv1d 250000x25 16->2000 k5", 250000, (25,), 16, 2000, 5),
+        # the cf formulation's inner conv (layer 2 of the PF-Pascal NC
+        # stack is EXACTLY case A's work: 2 TFLOP) + lane-padding probes
+        ("conv2d 10000x25^2 80->80 k5 (cf inner)", 10000, (25, 25), 80, 80, 5),
+        ("conv2d 10000x25^2 80->128 k5", 10000, (25, 25), 80, 128, 5),
+        ("conv2d 10000x25^2 128->128 k5", 10000, (25, 25), 128, 128, 5),
+        ("conv2d 2500x25^2 80->80 k5 (chunk4 cf)", 2500, (25, 25), 80, 80, 5),
     ]
     for name, b, sp, cin, cout, k in cases:
         x = jnp.asarray(rng.randn(b, *sp, cin), dt)

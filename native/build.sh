@@ -1,0 +1,9 @@
+#!/bin/sh
+# Build the host-side native fast paths into ncnet_tpu/data/_native/.
+# Requires g++ (baked into the image); no other dependencies.
+set -e
+cd "$(dirname "$0")"
+mkdir -p ../ncnet_tpu/data/_native
+g++ -O3 -shared -fPIC -std=c++17 resize.cpp \
+    -o ../ncnet_tpu/data/_native/libncnet_native.so
+echo "built ncnet_tpu/data/_native/libncnet_native.so"

@@ -1,0 +1,52 @@
+// Host-side bilinear align-corners resize, float32 HWC.
+//
+// The C++ fast path for the data loader's hot preprocessing op
+// (ncnet_tpu/data/images.py resize_bilinear_np). Semantics match the
+// reference's identity-affine grid_sample resize under PyTorch-0.3
+// align_corners behavior (lib/transformation.py:41-63): output pixel o
+// samples input position o * (L_in - 1) / (L_out - 1).
+//
+// Called through ctypes (ncnet_tpu/data/native.py), which releases the
+// GIL for the duration of the call — so the threaded DataLoader's workers
+// genuinely overlap. Build with native/build.sh.
+
+#include <cstdint>
+
+extern "C" {
+
+// in:  [h, w, c] contiguous float32
+// out: [oh, ow, c] contiguous float32 (caller-allocated)
+void ncnet_resize_bilinear_f32(const float* in, int64_t h, int64_t w,
+                               int64_t c, float* out, int64_t oh,
+                               int64_t ow) {
+  for (int64_t oy = 0; oy < oh; ++oy) {
+    const float py =
+        (oh == 1) ? 0.0f
+                  : static_cast<float>(oy) * static_cast<float>(h - 1) /
+                        static_cast<float>(oh - 1);
+    const int64_t y0 = static_cast<int64_t>(py);
+    const int64_t y1 = (y0 + 1 < h) ? y0 + 1 : h - 1;
+    const float fy = py - static_cast<float>(y0);
+    for (int64_t ox = 0; ox < ow; ++ox) {
+      const float px =
+          (ow == 1) ? 0.0f
+                    : static_cast<float>(ox) * static_cast<float>(w - 1) /
+                          static_cast<float>(ow - 1);
+      const int64_t x0 = static_cast<int64_t>(px);
+      const int64_t x1 = (x0 + 1 < w) ? x0 + 1 : w - 1;
+      const float fx = px - static_cast<float>(x0);
+      const float* p00 = in + (y0 * w + x0) * c;
+      const float* p01 = in + (y0 * w + x1) * c;
+      const float* p10 = in + (y1 * w + x0) * c;
+      const float* p11 = in + (y1 * w + x1) * c;
+      float* dst = out + (oy * ow + ox) * c;
+      for (int64_t ch = 0; ch < c; ++ch) {
+        const float top = p00[ch] * (1.0f - fx) + p01[ch] * fx;
+        const float bot = p10[ch] * (1.0f - fx) + p11[ch] * fx;
+        dst[ch] = top * (1.0f - fy) + bot * fy;
+      }
+    }
+  }
+}
+
+}  // extern "C"

@@ -3,8 +3,9 @@
 All host-side preprocessing is numpy (the device never sees raw images):
 decode with PIL, bilinear align-corners resize (parity with the reference's
 identity-affine grid_sample resize, lib/transformation.py:41-63), ImageNet
-normalization. A C++ fast path for resize+normalize is loaded via ctypes
-when built (`ncnet_tpu.data.native`).
+normalization. A C++ fast path for the resize (native/resize.cpp, built by
+native/build.sh) is loaded via ctypes when present and falls back to numpy
+otherwise (`ncnet_tpu.data.native`).
 """
 
 import numpy as np

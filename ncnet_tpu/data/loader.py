@@ -19,6 +19,8 @@ TPU-host-first:
 
 import queue
 import threading
+import time
+import traceback
 
 import numpy as np
 
@@ -95,6 +97,11 @@ class DataLoader:
         # always either buffered or in flight — no deadlock.
         inflight = threading.Semaphore(self.prefetch + self.num_workers)
 
+        # First worker exception (with its full traceback) — surfaced to
+        # the consumer promptly instead of a late generic error.
+        error = []
+        error_event = threading.Event()
+
         def worker():
             while not stop.is_set():
                 if not inflight.acquire(timeout=0.1):
@@ -104,7 +111,15 @@ class DataLoader:
                 except queue.Empty:
                     inflight.release()
                     return
-                batch = collate([self.dataset[int(i)] for i in b])
+                try:
+                    batch = collate([self.dataset[int(i)] for i in b])
+                except BaseException as e:  # noqa: BLE001 — re-raised below
+                    with lock:
+                        if not error:
+                            error.append((e, traceback.format_exc()))
+                    error_event.set()
+                    stop.set()
+                    return
                 with lock:
                     results[bi] = batch
 
@@ -115,19 +130,30 @@ class DataLoader:
         for t in threads:
             t.start()
 
+        def raise_worker_error():
+            exc, tb = error[0]
+            raise RuntimeError(
+                f"data worker failed on batch construction:\n{tb}"
+            ) from exc
+
         try:
             next_bi = 0
-            import time
-
             while next_bi < len(batches):
+                if error_event.is_set():
+                    raise_worker_error()
                 with lock:
                     batch = results.pop(next_bi, None)
                 if batch is None:
-                    if not any(t.is_alive() for t in threads) and next_bi not in results:
+                    if not any(t.is_alive() for t in threads):
                         with lock:
                             batch = results.pop(next_bi, None)
                         if batch is None:
-                            raise RuntimeError("data workers died before finishing")
+                            if error_event.is_set():
+                                raise_worker_error()
+                            raise RuntimeError(
+                                "data workers exited before producing batch "
+                                f"{next_bi}/{len(batches)}"
+                            )
                     else:
                         time.sleep(0.002)
                         continue

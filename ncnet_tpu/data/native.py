@@ -1,0 +1,76 @@
+"""ctypes loader for the host-side C++ fast paths (native/resize.cpp).
+
+Build with ``native/build.sh`` (g++, no other deps); the library lands in
+``ncnet_tpu/data/_native/libncnet_native.so`` or is pointed to by the
+``NCNET_NATIVE_LIB`` env var. Every entry point degrades gracefully:
+when the library is absent the functions return ``None`` and callers fall
+back to their numpy implementations.
+
+Why native: the loader uses worker THREADS (data/loader.py); ctypes calls
+release the GIL for the duration of the C call, so resize work in multiple
+workers genuinely runs in parallel — the numpy fallback holds the GIL in
+its gather/arith steps.
+"""
+
+import ctypes
+import os
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = os.environ.get("NCNET_NATIVE_LIB") or os.path.join(
+        os.path.dirname(__file__), "_native", "libncnet_native.so"
+    )
+    if not os.path.exists(path):
+        return None
+    lib = ctypes.CDLL(path)
+    lib.ncnet_resize_bilinear_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64,
+        ctypes.c_int64,
+    ]
+    lib.ncnet_resize_bilinear_f32.restype = None
+    _LIB = lib
+    return lib
+
+
+def native_available():
+    return _load() is not None
+
+
+def resize_bilinear_native(image, out_h, out_w):
+    """Align-corners bilinear resize of ``[h, w, c]`` float32.
+
+    Returns the resized array, or ``None`` when the native library is not
+    built (callers fall back to numpy).
+    """
+    lib = _load()
+    if lib is None or np.ndim(image) != 3:
+        return None
+    img = np.ascontiguousarray(image, np.float32)
+    h, w, c = img.shape
+    if (h, w) == (out_h, out_w):
+        return img
+    out = np.empty((out_h, out_w, c), np.float32)
+    lib.ncnet_resize_bilinear_f32(
+        img.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        h,
+        w,
+        c,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out_h,
+        out_w,
+    )
+    return out

@@ -1,0 +1,121 @@
+"""DenseNet-201 feature trunk (to ``transition2``), NHWC, frozen eval BN.
+
+Replicates the torchvision DenseNet-201 front that the reference truncates
+with ``features.children()[:-4]`` — "up to transitionlayer2"
+(lib/model.py:69-74): conv0/norm0/relu0/pool0, denseblock1 (6 layers),
+transition1, denseblock2 (12 layers), transition2. Output is stride 16 with
+256 channels. BatchNorm is always inference-mode affine (the reference
+freezes the backbone, lib/model.py:75-78).
+
+Parameter tree mirrors torchvision naming for mechanical conversion
+(`ncnet_tpu.utils.convert_torch.convert_densenet201_trunk`):
+
+  {'conv0': {'kernel'}, 'norm0': bn,
+   'denseblock1': [{'norm1': bn, 'conv1': {'kernel'},
+                    'norm2': bn, 'conv2': {'kernel'}}, ... x6],
+   'transition1': {'norm': bn, 'conv': {'kernel'}},
+   'denseblock2': [... x12],
+   'transition2': {'norm': bn, 'conv': {'kernel'}}}
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ncnet_tpu.models.resnet import (
+    _bn_apply,
+    _bn_init,
+    _conv,
+    _max_pool_3x3_s2,
+)
+
+GROWTH_RATE = 32
+BN_SIZE = 4
+NUM_INIT_FEATURES = 64
+# denseblock sizes up to the truncation point (DenseNet-201 = 6, 12, 48, 32)
+TRUNK_BLOCKS = (6, 12)
+
+
+def _conv_init(rng, kh, kw, cin, cout):
+    # He-normal fan-in (torchvision's DenseNet kaiming_normal_ default).
+    fan_in = kh * kw * cin
+    std = (2.0 / fan_in) ** 0.5
+    return jax.random.normal(rng, (kh, kw, cin, cout)) * std
+
+
+def _init_dense_layer(rng, cin):
+    k1, k2 = jax.random.split(rng)
+    bottleneck = BN_SIZE * GROWTH_RATE
+    return {
+        "norm1": _bn_init(cin),
+        "conv1": {"kernel": _conv_init(k1, 1, 1, cin, bottleneck)},
+        "norm2": _bn_init(bottleneck),
+        "conv2": {"kernel": _conv_init(k2, 3, 3, bottleneck, GROWTH_RATE)},
+    }
+
+
+def _apply_dense_layer(p, x):
+    # torchvision _DenseLayer: BN -> ReLU -> 1x1 -> BN -> ReLU -> 3x3 (pad 1),
+    # then the 32 new features are concatenated onto the running stack.
+    out = jax.nn.relu(_bn_apply(p["norm1"], x))
+    out = _conv(out, p["conv1"]["kernel"])
+    out = jax.nn.relu(_bn_apply(p["norm2"], out))
+    out = _conv(out, p["conv2"]["kernel"], padding=((1, 1), (1, 1)))
+    return jnp.concatenate([x, out], axis=-1)
+
+
+def _avg_pool_2x2_s2(x):
+    summed = lax.reduce_window(
+        x,
+        0.0,
+        lax.add,
+        window_dimensions=(1, 2, 2, 1),
+        window_strides=(1, 2, 2, 1),
+        padding="VALID",
+    )
+    return summed * 0.25
+
+
+def _apply_transition(p, x):
+    # torchvision _Transition: BN -> ReLU -> 1x1 (halve channels) -> avgpool.
+    out = jax.nn.relu(_bn_apply(p["norm"], x))
+    out = _conv(out, p["conv"]["kernel"])
+    return _avg_pool_2x2_s2(out)
+
+
+def init_densenet201_trunk(rng):
+    """Random (He) init; real use loads converted torchvision weights."""
+    keys = jax.random.split(rng, 2 * len(TRUNK_BLOCKS) + 1)
+    params = {
+        "conv0": {"kernel": _conv_init(keys[0], 7, 7, 3, NUM_INIT_FEATURES)},
+        "norm0": _bn_init(NUM_INIT_FEATURES),
+    }
+    cin = NUM_INIT_FEATURES
+    for bi, n_layers in enumerate(TRUNK_BLOCKS):
+        layer_keys = jax.random.split(keys[1 + 2 * bi], n_layers)
+        block = []
+        for li in range(n_layers):
+            block.append(_init_dense_layer(layer_keys[li], cin))
+            cin += GROWTH_RATE
+        params[f"denseblock{bi + 1}"] = block
+        cout = cin // 2
+        params[f"transition{bi + 1}"] = {
+            "norm": _bn_init(cin),
+            "conv": {
+                "kernel": _conv_init(keys[2 + 2 * bi], 1, 1, cin, cout)
+            },
+        }
+        cin = cout
+    return params
+
+
+def densenet201_trunk_apply(params, x):
+    """``[b, h, w, 3]`` normalized image -> ``[b, h/16, w/16, 256]``."""
+    x = _conv(x, params["conv0"]["kernel"], stride=2, padding=((3, 3), (3, 3)))
+    x = jax.nn.relu(_bn_apply(params["norm0"], x))
+    x = _max_pool_3x3_s2(x)
+    for bi in range(len(TRUNK_BLOCKS)):
+        for layer in params[f"denseblock{bi + 1}"]:
+            x = _apply_dense_layer(layer, x)
+        x = _apply_transition(params[f"transition{bi + 1}"], x)
+    return x

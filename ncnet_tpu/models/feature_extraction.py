@@ -8,12 +8,18 @@ intentionally not reproduced.
 
 import jax.numpy as jnp
 
-from ncnet_tpu.models import resnet, vgg
+from ncnet_tpu.models import densenet, resnet, vgg
 from ncnet_tpu.ops.norm import feature_l2norm
 
 BACKBONES = {
     "resnet101": (resnet.init_resnet101_trunk, resnet.resnet101_trunk_apply, 16, 1024),
     "vgg": (vgg.init_vgg16_trunk, vgg.vgg16_trunk_apply, 16, 512),
+    "densenet201": (
+        densenet.init_densenet201_trunk,
+        densenet.densenet201_trunk_apply,
+        16,
+        256,
+    ),
 }
 
 

@@ -16,7 +16,13 @@ from ncnet_tpu.ops.correlation import (
     correlation_4d,
     correlation_maxpool4d,
 )
-from ncnet_tpu.ops.image import imagenet_normalize, resize_bilinear_align_corners
+from ncnet_tpu.ops.image import (
+    affine_grid,
+    affine_transform,
+    grid_sample,
+    imagenet_normalize,
+    resize_bilinear_align_corners,
+)
 from ncnet_tpu.ops.matches import (
     bilinear_point_transfer,
     corr_to_matches,
@@ -44,4 +50,7 @@ __all__ = [
     "points_to_pixel_coords",
     "imagenet_normalize",
     "resize_bilinear_align_corners",
+    "affine_grid",
+    "affine_transform",
+    "grid_sample",
 ]

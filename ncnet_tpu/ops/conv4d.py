@@ -502,7 +502,7 @@ def _conv4d_gemms(x, w):
     return jnp.moveaxis(out, 0, 1)
 
 
-def conv4d(x, w, bias=None, impl="xla"):
+def conv4d(x, w, bias=None, impl="xla", interpret=None):
     """SAME, stride-1 4D convolution.
 
     Args:
@@ -521,6 +521,7 @@ def conv4d(x, w, bias=None, impl="xla"):
         'gemms' is the scanned low-memory variant) |
         'pallas' (hand-written TPU kernel on the packed layout,
         kernels/conv4d_pallas.py; hypercubic kernels only).
+      interpret: for impl='pallas' only — see `conv4d_packed`.
 
     Returns:
       ``[b, i, j, k, l, c_out]``.
@@ -530,7 +531,7 @@ def conv4d(x, w, bias=None, impl="xla"):
         cout = w.shape[-1]
         out = conv4d_packed(
             x.reshape(b, i, j, k * l * cin), w, (k, l), bias=bias,
-            impl="pallas",
+            impl="pallas", interpret=interpret,
         )
         return out.reshape(b, i, j, k, l, cout)
     if impl == "xla":

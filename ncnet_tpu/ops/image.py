@@ -6,8 +6,13 @@ The reference resizes images with an identity-affine ``F.grid_sample``
 semantics, i.e. sampling at ``linspace(0, L-1, out)``. `jax.image.resize`
 uses half-pixel centers, so a dedicated align-corners bilinear resize is
 provided for parity.
+
+`affine_grid` + `grid_sample` generalize this to arbitrary affine thetas
+(the full ``AffineGridGen``/``AffineTnf`` surface of the reference,
+lib/transformation.py:15-63), enabling device-side affine augmentation.
 """
 
+import jax
 import jax.numpy as jnp
 
 IMAGENET_MEAN = (0.485, 0.456, 0.406)
@@ -32,6 +37,72 @@ def imagenet_unnormalize(image):
     mean = jnp.asarray(IMAGENET_MEAN, image.dtype)
     std = jnp.asarray(IMAGENET_STD, image.dtype)
     return image * std + mean
+
+
+def affine_grid(theta, out_h, out_w):
+    """Affine sampling grid, torch ``F.affine_grid`` align-corners semantics.
+
+    Reference ``AffineGridGen`` (lib/transformation.py:51-63). The base grid
+    spans [-1, 1] inclusive on both axes (align_corners=True).
+
+    Args:
+      theta: ``[b, 2, 3]`` affine matrices mapping OUTPUT normalized coords
+        (x, y, 1) to INPUT normalized sample positions.
+
+    Returns:
+      ``[b, out_h, out_w, 2]`` of (x, y) sample positions in [-1, 1].
+    """
+    xs = jnp.linspace(-1.0, 1.0, out_w, dtype=theta.dtype)
+    ys = jnp.linspace(-1.0, 1.0, out_h, dtype=theta.dtype)
+    gx, gy = jnp.meshgrid(xs, ys)  # [out_h, out_w]
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [h, w, 3]
+    return jnp.einsum("hwk,bik->bhwi", base, theta)
+
+
+def grid_sample(image, grid):
+    """Bilinear sampling, torch ``F.grid_sample`` align-corners + zeros
+    padding semantics (reference ``AffineTnf``, lib/transformation.py:41-46).
+
+    Each of the four corner taps is zeroed individually when it falls
+    outside the image (torch 'zeros' padding_mode).
+
+    Args:
+      image: ``[b, h, w, c]`` channels-last.
+      grid: ``[b, gh, gw, 2]`` of (x, y) sample positions in [-1, 1].
+
+    Returns:
+      ``[b, gh, gw, c]``.
+    """
+    b, h, w, c = image.shape
+    gx = (grid[..., 0] + 1.0) * 0.5 * (w - 1)
+    gy = (grid[..., 1] + 1.0) * 0.5 * (h - 1)
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+
+    def tap(xi, yi):
+        inb = (xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        vals = jax.vmap(lambda img, yy, xx: img[yy, xx])(image, yc, xc)
+        return vals * inb[..., None].astype(image.dtype)
+
+    wx1 = (gx - x0).astype(image.dtype)[..., None]
+    wy1 = (gy - y0).astype(image.dtype)[..., None]
+    wx0 = 1.0 - wx1
+    wy0 = 1.0 - wy1
+    return (
+        tap(x0, y0) * wx0 * wy0
+        + tap(x0 + 1, y0) * wx1 * wy0
+        + tap(x0, y0 + 1) * wx0 * wy1
+        + tap(x0 + 1, y0 + 1) * wx1 * wy1
+    )
+
+
+def affine_transform(image, theta, out_h, out_w):
+    """Warp ``image`` by affine ``theta`` — the reference ``AffineTnf``
+    forward (lib/transformation.py:37-46). Identity theta reduces to
+    `resize_bilinear_align_corners`."""
+    return grid_sample(image, affine_grid(theta, out_h, out_w))
 
 
 def resize_bilinear_align_corners(image, out_h, out_w):

@@ -53,6 +53,8 @@ def train(
     initial_train_hist=None,
     initial_val_hist=None,
     log_every=10,
+    profile_dir=None,
+    profile_steps=(3, 8),
 ):
     mesh = make_mesh() if data_parallel and len(jax.devices()) > 1 else None
     if mesh is not None:
@@ -80,18 +82,44 @@ def train(
         if initial_train_hist is not None else []
     val_hist = [float(v) for v in np.asarray(initial_val_hist).ravel()] \
         if initial_val_hist is not None else []
+    # Optional jax.profiler capture (SURVEY §5: the reference has no
+    # tracing at all): trace steps [profile_steps) of the first epoch into
+    # profile_dir, viewable with tensorboard/xprof.
+    profiling = False
     for epoch in range(start_epoch, num_epochs):
         t0 = time.time()
+        t_last = t0
         losses = []
         for i, batch in enumerate(train_loader):
+            if profile_dir and epoch == start_epoch:
+                if i == profile_steps[0]:
+                    jax.profiler.start_trace(profile_dir)
+                    profiling = True
+                elif i == profile_steps[1] and profiling:
+                    # D2H sync so the device finishes the profiled steps
+                    # before the trace closes (block_until_ready does not
+                    # block on the tunneled platform — see bench.py)
+                    if losses:
+                        float(losses[-1])
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    print(f"profile trace written to {profile_dir}", flush=True)
             state, loss = train_step(state, _device_batch(mesh, batch))
             if (i + 1) % log_every == 0:
+                # the float() D2H sync makes the step timing honest
+                loss_host = float(loss)
+                now = time.time()
+                ms = (now - t_last) / log_every * 1e3
+                t_last = now
                 print(
                     f"epoch {epoch + 1} [{i + 1}/{len(train_loader)}] "
-                    f"loss {float(loss):.6f}",
+                    f"loss {loss_host:.6f} ({ms:.0f} ms/step)",
                     flush=True,
                 )
             losses.append(loss)
+        if profiling:  # epoch shorter than the profile window
+            jax.profiler.stop_trace()
+            profiling = False
         train_loss = float(np.mean([float(l) for l in losses])) if losses else 0.0
         train_hist.append(train_loss)
 

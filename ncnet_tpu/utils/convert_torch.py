@@ -15,6 +15,8 @@ torch is only needed inside these functions (CPU-only is fine); the rest of
 the framework never imports it.
 """
 
+import re
+
 import numpy as np
 
 
@@ -36,6 +38,22 @@ def _bn(sd, prefix):
     }
 
 
+def _normalize_seq_keys(state_dict, prefix, seq_map):
+    """Strip ``prefix`` and rename leading Sequential indices per ``seq_map``
+    (the reference saves truncated trunks as ``nn.Sequential``, so keys are
+    ``0.weight`` etc.; raw torchvision checkpoints use attribute names)."""
+    sd = {k[len(prefix):]: v for k, v in state_dict.items() if k.startswith(prefix)}
+    if not sd:
+        raise ValueError(f"no keys under prefix {prefix!r}")
+    norm = {}
+    for k, v in sd.items():
+        head, _, rest = k.partition(".")
+        if head in seq_map:
+            k = seq_map[head] + ("." + rest if rest else "")
+        norm[k] = v
+    return norm
+
+
 def convert_resnet101_trunk(state_dict, prefix="FeatureExtraction.model."):
     """torchvision-style resnet state dict -> `models.resnet` param tree.
 
@@ -43,18 +61,11 @@ def convert_resnet101_trunk(state_dict, prefix="FeatureExtraction.model."):
     as saved by the reference's truncated model) or attribute keys
     (``conv1.weight``, ``layer1.0...``, as in raw torchvision checkpoints).
     """
-    sd = {k[len(prefix):]: v for k, v in state_dict.items() if k.startswith(prefix)}
-    if not sd:
-        raise ValueError(f"no keys under prefix {prefix!r}")
-    # normalize Sequential indices to attribute names
-    seq_map = {"0": "conv1", "1": "bn1", "4": "layer1", "5": "layer2", "6": "layer3"}
-    norm = {}
-    for k, v in sd.items():
-        head, _, rest = k.partition(".")
-        if head in seq_map:
-            k = seq_map[head] + ("." + rest if rest else "")
-        norm[k] = v
-    sd = norm
+    sd = _normalize_seq_keys(
+        state_dict,
+        prefix,
+        {"0": "conv1", "1": "bn1", "4": "layer1", "5": "layer2", "6": "layer3"},
+    )
 
     from ncnet_tpu.models.resnet import RESNET101_STAGES
 
@@ -97,6 +108,64 @@ def convert_vgg16_trunk(state_dict, prefix="FeatureExtraction.model."):
                 "bias": _np(sd[f"{idx}.bias"]),
             }
         )
+    return params
+
+
+def convert_densenet201_trunk(state_dict, prefix="FeatureExtraction.model."):
+    """torchvision-style densenet201 state dict -> `models.densenet` tree.
+
+    Accepts Sequential-index keys (``0.weight`` = conv0 .. ``7.`` =
+    transition2, as saved by the reference's ``features.children()[:-4]``
+    truncation, lib/model.py:74) or attribute keys (``conv0.weight``,
+    ``denseblock1.denselayer1.norm1.weight``, as in raw torchvision
+    checkpoints, with or without a leading ``features.``), including the
+    legacy zoo-file names ``denselayer*.norm.1`` / ``conv.2`` that
+    torchvision itself regex-remaps at load time.
+    """
+    sd = _normalize_seq_keys(
+        state_dict,
+        prefix,
+        {
+            "0": "conv0",
+            "1": "norm0",
+            "4": "denseblock1",
+            "5": "transition1",
+            "6": "denseblock2",
+            "7": "transition2",
+        },
+    )
+    # legacy torchvision zoo naming: 'denselayerN.norm.1.weight' etc.
+    sd = {
+        re.sub(
+            r"(denselayer\d+\.(?:norm|conv))\.(\d)\.", r"\1\2.", k
+        ): v
+        for k, v in sd.items()
+    }
+
+    from ncnet_tpu.models.densenet import TRUNK_BLOCKS
+
+    params = {
+        "conv0": {"kernel": _conv2d_kernel(sd["conv0.weight"])},
+        "norm0": _bn(sd, "norm0"),
+    }
+    for bi, n_layers in enumerate(TRUNK_BLOCKS):
+        block = []
+        for li in range(n_layers):
+            p = f"denseblock{bi + 1}.denselayer{li + 1}."
+            block.append(
+                {
+                    "norm1": _bn(sd, p + "norm1"),
+                    "conv1": {"kernel": _conv2d_kernel(sd[p + "conv1.weight"])},
+                    "norm2": _bn(sd, p + "norm2"),
+                    "conv2": {"kernel": _conv2d_kernel(sd[p + "conv2.weight"])},
+                }
+            )
+        params[f"denseblock{bi + 1}"] = block
+        t = f"transition{bi + 1}."
+        params[f"transition{bi + 1}"] = {
+            "norm": _bn(sd, t + "norm"),
+            "conv": {"kernel": _conv2d_kernel(sd[t + "conv.weight"])},
+        }
     return params
 
 
@@ -150,6 +219,10 @@ def load_trunk_weights(path, cnn="resnet101"):
         if prefix == "" and any(k.startswith("features.") for k in sd):
             prefix = "features."
         return convert_vgg16_trunk(sd, prefix=prefix)
+    if cnn == "densenet201":
+        if prefix == "" and any(k.startswith("features.") for k in sd):
+            prefix = "features."
+        return convert_densenet201_trunk(sd, prefix=prefix)
     raise ValueError(f"unsupported backbone for trunk conversion: {cnn!r}")
 
 
@@ -179,6 +252,8 @@ def convert_checkpoint(path):
         fe = convert_resnet101_trunk(sd)
     elif cnn == "vgg":
         fe = convert_vgg16_trunk(sd)
+    elif cnn == "densenet201":
+        fe = convert_densenet201_trunk(sd)
     else:
         raise ValueError(f"unsupported backbone in checkpoint: {cnn!r}")
     params = {

@@ -52,6 +52,9 @@ def main():
     p.add_argument("--num_workers", type=int, default=4)
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("--bf16", action="store_true", help="bfloat16 compute path")
+    p.add_argument("--profile_dir", type=str, default="",
+                   help="capture a jax.profiler trace of a few early steps "
+                        "into this directory")
     p.add_argument("--conv4d_impl", type=str, default="cf",
                    choices=["xla", "taps", "scan", "tlc", "tf3", "tf2",
                             "cf", "cfs", "gemm", "gemms", "pallas"])
@@ -76,19 +79,24 @@ def main():
     start_epoch, start_step, opt_state, best_val = 0, 0, None, None
     train_hist = val_hist = None
     if args.checkpoint and args.checkpoint.endswith((".pth.tar", ".pth")):
+        import torch
+
         from ncnet_tpu.utils.convert_torch import convert_checkpoint
 
-        try:
-            config, params = convert_checkpoint(args.checkpoint)
-        except (KeyError, AttributeError) as e:
+        blob = torch.load(
+            args.checkpoint, map_location="cpu", weights_only=False
+        )
+        if not (isinstance(blob, dict) and "state_dict" in blob):
             # A raw torchvision state dict (trunk-only weights) has no
-            # 'state_dict'/'args'/'NeighConsensus' entries — that file
-            # belongs to --fe_weights, not --checkpoint.
+            # 'state_dict'/'args' envelope — that file belongs to
+            # --fe_weights. Genuine conversion failures of a full
+            # checkpoint fall through with their real traceback.
             p.error(
                 f"{args.checkpoint} is not a full reference training "
-                f"checkpoint ({type(e).__name__}: {e}); for trunk-only "
-                "weights (e.g. a raw torchvision .pth) use --fe_weights"
+                "checkpoint (no 'state_dict' key); for trunk-only weights "
+                "(e.g. a raw torchvision .pth) use --fe_weights"
             )
+        config, params = convert_checkpoint(args.checkpoint)
         config = config.replace(
             half_precision=args.bf16, conv4d_impl=args.conv4d_impl,
             nc_remat=True,
@@ -164,6 +172,7 @@ def main():
         initial_best_val=best_val,
         initial_train_hist=train_hist,
         initial_val_hist=val_hist,
+        profile_dir=args.profile_dir or None,
     )
 
 

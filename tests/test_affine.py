@@ -1,0 +1,79 @@
+"""Parity of the general affine resampler with torch F.affine_grid /
+F.grid_sample (align_corners=True, zeros padding) — the PyTorch-0.3
+semantics of the reference's AffineGridGen/AffineTnf
+(lib/transformation.py:15-63)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ncnet_tpu.ops.image import (
+    affine_grid,
+    affine_transform,
+    grid_sample,
+    resize_bilinear_align_corners,
+)
+
+
+def _torch_affine_sample(img_nhwc, theta, out_h, out_w):
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    t_img = torch.from_numpy(img_nhwc.transpose(0, 3, 1, 2))
+    t_theta = torch.from_numpy(theta)
+    grid = F.affine_grid(
+        t_theta, (img_nhwc.shape[0], img_nhwc.shape[3], out_h, out_w),
+        align_corners=True,
+    )
+    out = F.grid_sample(
+        t_img, grid, mode="bilinear", padding_mode="zeros", align_corners=True
+    )
+    return out.numpy().transpose(0, 2, 3, 1)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_affine_transform_matches_torch_random_theta(seed):
+    rng = np.random.RandomState(seed)
+    img = rng.rand(2, 13, 17, 3).astype(np.float32)
+    # random affines around identity, large enough to push samples
+    # out of bounds (exercising the zeros-padding path)
+    theta = (
+        np.tile(np.asarray([[1, 0, 0], [0, 1, 0]], np.float32), (2, 1, 1))
+        + rng.randn(2, 2, 3).astype(np.float32) * 0.3
+    )
+    got = np.asarray(affine_transform(jnp.asarray(img), jnp.asarray(theta), 11, 19))
+    want = _torch_affine_sample(img, theta, 11, 19)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_affine_grid_matches_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    rng = np.random.RandomState(3)
+    theta = rng.randn(2, 2, 3).astype(np.float32)
+    got = np.asarray(affine_grid(jnp.asarray(theta), 7, 9))
+    want = F.affine_grid(
+        torch.from_numpy(theta), (2, 1, 7, 9), align_corners=True
+    ).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_identity_affine_reduces_to_resize():
+    """The reference uses AffineTnf with identity theta purely as a resize
+    (lib/transformation.py:41-46, lib/pf_dataset.py:96-97)."""
+    rng = np.random.RandomState(4)
+    img = rng.rand(1, 10, 14, 3).astype(np.float32)
+    theta = np.asarray([[[1, 0, 0], [0, 1, 0]]], np.float32)
+    got = np.asarray(affine_transform(jnp.asarray(img), jnp.asarray(theta), 21, 9))
+    want = np.asarray(resize_bilinear_align_corners(jnp.asarray(img), 21, 9))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_grid_sample_zeros_padding():
+    """Samples fully outside the image are exactly zero."""
+    img = jnp.ones((1, 5, 5, 2), jnp.float32)
+    grid = jnp.full((1, 3, 3, 2), 3.0, jnp.float32)  # far outside [-1, 1]
+    out = np.asarray(grid_sample(img, grid))
+    np.testing.assert_array_equal(out, np.zeros_like(out))

@@ -133,6 +133,28 @@ def test_loader_sharding():
     assert sorted(np.concatenate([idx0, idx1]).tolist()) == list(range(10))
 
 
+def test_loader_surfaces_worker_exception_fast():
+    """A poisoned dataset must raise the ORIGINAL exception (with its
+    traceback text) promptly — not a late generic 'workers died' error."""
+    import time
+
+    class Poisoned:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, idx):
+            if idx == 2:
+                raise ValueError("poisoned sample 2")
+            return {"x": np.zeros((2,), np.float32)}
+
+    loader = DataLoader(Poisoned(), 2, num_workers=2)
+    t0 = time.time()
+    with pytest.raises(RuntimeError, match="poisoned sample 2"):
+        for _ in loader:
+            pass
+    assert time.time() - t0 < 1.0
+
+
 def test_collate():
     out = collate([{"a": np.zeros((2, 2), np.float32)}, {"a": np.ones((2, 2), np.float32)}])
     assert out["a"].shape == (2, 2, 2)

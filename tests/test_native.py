@@ -1,0 +1,79 @@
+"""Native C++ resize fast path: build, load, numpy parity.
+
+The library is compiled IN-TEST with g++ (baked into the image) into a tmp
+dir and loaded via the NCNET_NATIVE_LIB env override, so the test works
+from a clean tree and guards the .cpp against regressions.
+"""
+
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def native_lib(tmp_path_factory):
+    if shutil.which("g++") is None:
+        pytest.skip("g++ not available")
+    out = tmp_path_factory.mktemp("native") / "libncnet_native.so"
+    subprocess.run(
+        [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            os.path.join(REPO, "native", "resize.cpp"), "-o", str(out),
+        ],
+        check=True,
+    )
+    return str(out)
+
+
+def _fresh_native(monkeypatch, lib_path):
+    """Import native.py with a fresh load state pointed at lib_path."""
+    import importlib
+
+    from ncnet_tpu.data import native
+
+    monkeypatch.setenv("NCNET_NATIVE_LIB", lib_path)
+    importlib.reload(native)
+    return native
+
+
+def test_native_resize_matches_numpy(native_lib, monkeypatch):
+    native = _fresh_native(monkeypatch, native_lib)
+    assert native.native_available()
+
+    from ncnet_tpu.data.images import resize_bilinear_np
+
+    rng = np.random.RandomState(0)
+    for (h, w), (oh, ow) in [((37, 53), (25, 25)), ((8, 8), (16, 24)),
+                             ((10, 10), (1, 1)), ((5, 7), (5, 7))]:
+        img = rng.rand(h, w, 3).astype(np.float32) * 255.0
+        got = native.resize_bilinear_native(img, oh, ow)
+        assert got is not None and got.shape == (oh, ow, 3)
+        # numpy fallback path, bypassing the native hook
+        want_src = img if (h, w) != (oh, ow) else img.copy()
+        fy = np.linspace(0.0, h - 1.0, oh)
+        fx = np.linspace(0.0, w - 1.0, ow)
+        y0 = np.floor(fy).astype(int)
+        x0 = np.floor(fx).astype(int)
+        y1 = np.minimum(y0 + 1, h - 1)
+        x1 = np.minimum(x0 + 1, w - 1)
+        wy = (fy - y0)[:, None, None]
+        wx = (fx - x0)[None, :, None]
+        top = want_src[y0][:, x0] * (1 - wx) + want_src[y0][:, x1] * wx
+        bot = want_src[y1][:, x0] * (1 - wx) + want_src[y1][:, x1] * wx
+        want = top * (1 - wy) + bot * wy
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-3)
+        # and the public entry agrees with itself via images.py fallback
+        np.testing.assert_allclose(
+            got, resize_bilinear_np(img, oh, ow), rtol=1e-5, atol=1e-3
+        )
+
+
+def test_native_absent_returns_none(monkeypatch, tmp_path):
+    native = _fresh_native(monkeypatch, str(tmp_path / "missing.so"))
+    assert not native.native_available()
+    assert native.resize_bilinear_native(np.zeros((4, 4, 3), np.float32), 2, 2) is None

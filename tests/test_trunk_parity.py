@@ -1,0 +1,329 @@
+"""Full-trunk and full-model numerical parity against in-test torch oracles.
+
+SURVEY.md §7.3-4 flags backbone parity as the top correctness hazard: small
+feature drift is amplified by the correlation/argmax readout. These tests
+assemble the torch-side computation directly from a reference-style state
+dict (torch only — torchvision is not installed here), convert the same
+state dict with `ncnet_tpu.utils.convert_torch`, and require the two
+forwards to agree:
+
+  * whole ResNet-101 trunk (conv1 .. layer3, reference lib/model.py:37-44)
+  * whole DenseNet-201 trunk (conv0 .. transition2, lib/model.py:69-74)
+  * the fully assembled ImMatchNet pipeline: trunk -> L2 norm -> 4D
+    correlation -> MutualMatching -> symmetric NeighConsensus ->
+    MutualMatching (lib/model.py:261-282), with reference eps values.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+from ncnet_tpu.models.densenet import TRUNK_BLOCKS, densenet201_trunk_apply
+from ncnet_tpu.models.resnet import RESNET101_STAGES, resnet101_trunk_apply
+from ncnet_tpu.utils import convert_torch
+
+EXPANSION = 4
+
+
+# ---------------------------------------------------------------- state dicts
+
+
+def _conv(sd, g, name, cout, cin, k, scale=0.1):
+    sd[name + ".weight"] = torch.randn(cout, cin, k, k, generator=g) * scale
+
+
+def _bn(sd, g, name, c, scale_lo=0.5):
+    sd[name + ".weight"] = torch.rand(c, generator=g) * 0.5 + scale_lo
+    sd[name + ".bias"] = torch.randn(c, generator=g) * 0.1
+    sd[name + ".running_mean"] = torch.randn(c, generator=g) * 0.1
+    sd[name + ".running_var"] = torch.rand(c, generator=g) + 0.5
+
+
+def _resnet_sd(prefix=""):
+    # conv/BN scales kept small: 23 random residual blocks otherwise
+    # amplify activations to ~1e20, where fp32 parity is meaningless
+    g = torch.Generator().manual_seed(0)
+    sd = {}
+    _conv(sd, g, prefix + "conv1", 64, 3, 7)
+    _bn(sd, g, prefix + "bn1", 64)
+    cin = 64
+    for si, (n_blocks, planes, _) in enumerate(RESNET101_STAGES):
+        for bi in range(n_blocks):
+            p = f"{prefix}layer{si + 1}.{bi}."
+            _conv(sd, g, p + "conv1", planes, cin, 1, scale=0.03)
+            _bn(sd, g, p + "bn1", planes, scale_lo=0.3)
+            _conv(sd, g, p + "conv2", planes, planes, 3, scale=0.03)
+            _bn(sd, g, p + "bn2", planes, scale_lo=0.3)
+            _conv(sd, g, p + "conv3", planes * EXPANSION, planes, 1, scale=0.03)
+            _bn(sd, g, p + "bn3", planes * EXPANSION, scale_lo=0.3)
+            if bi == 0:
+                _conv(sd, g, p + "downsample.0", planes * EXPANSION, cin, 1)
+                _bn(sd, g, p + "downsample.1", planes * EXPANSION)
+            cin = planes * EXPANSION
+    return sd
+
+
+def _densenet_sd(prefix=""):
+    g = torch.Generator().manual_seed(1)
+    sd = {}
+    _conv(sd, g, prefix + "conv0", 64, 3, 7)
+    _bn(sd, g, prefix + "norm0", 64)
+    cin = 64
+    for bi, n_layers in enumerate(TRUNK_BLOCKS):
+        for li in range(n_layers):
+            p = f"{prefix}denseblock{bi + 1}.denselayer{li + 1}."
+            _bn(sd, g, p + "norm1", cin)
+            _conv(sd, g, p + "conv1", 128, cin, 1)
+            _bn(sd, g, p + "norm2", 128)
+            _conv(sd, g, p + "conv2", 32, 128, 3)
+            cin += 32
+        t = f"{prefix}transition{bi + 1}."
+        _bn(sd, g, t + "norm", cin)
+        _conv(sd, g, t + "conv", cin // 2, cin, 1)
+        cin //= 2
+    return sd
+
+
+# -------------------------------------------------------------- torch oracles
+
+
+def _tbn(sd, name, t):
+    return F.batch_norm(
+        t,
+        sd[name + ".running_mean"],
+        sd[name + ".running_var"],
+        sd[name + ".weight"],
+        sd[name + ".bias"],
+        training=False,
+        eps=1e-5,
+    )
+
+
+def _torch_resnet_trunk(sd, x):
+    x = F.conv2d(x, sd["conv1.weight"], stride=2, padding=3)
+    x = F.relu(_tbn(sd, "bn1", x))
+    x = F.max_pool2d(x, 3, stride=2, padding=1)
+    for si, (n_blocks, _, stride) in enumerate(RESNET101_STAGES):
+        for bi in range(n_blocks):
+            p = f"layer{si + 1}.{bi}."
+            s = stride if bi == 0 else 1
+            out = F.relu(_tbn(sd, p + "bn1", F.conv2d(x, sd[p + "conv1.weight"])))
+            out = F.relu(
+                _tbn(
+                    sd,
+                    p + "bn2",
+                    F.conv2d(out, sd[p + "conv2.weight"], stride=s, padding=1),
+                )
+            )
+            out = _tbn(sd, p + "bn3", F.conv2d(out, sd[p + "conv3.weight"]))
+            if p + "downsample.0.weight" in sd:
+                sc = _tbn(
+                    sd,
+                    p + "downsample.1",
+                    F.conv2d(x, sd[p + "downsample.0.weight"], stride=s),
+                )
+            else:
+                sc = x
+            x = F.relu(out + sc)
+    return x
+
+
+def _torch_densenet_trunk(sd, x):
+    x = F.conv2d(x, sd["conv0.weight"], stride=2, padding=3)
+    x = F.relu(_tbn(sd, "norm0", x))
+    x = F.max_pool2d(x, 3, stride=2, padding=1)
+    for bi, n_layers in enumerate(TRUNK_BLOCKS):
+        for li in range(n_layers):
+            p = f"denseblock{bi + 1}.denselayer{li + 1}."
+            out = F.conv2d(F.relu(_tbn(sd, p + "norm1", x)), sd[p + "conv1.weight"])
+            out = F.conv2d(
+                F.relu(_tbn(sd, p + "norm2", out)), sd[p + "conv2.weight"], padding=1
+            )
+            x = torch.cat([x, out], dim=1)
+        t = f"transition{bi + 1}."
+        x = F.conv2d(F.relu(_tbn(sd, t + "norm", x)), sd[t + "conv.weight"])
+        x = F.avg_pool2d(x, 2, stride=2)
+    return x
+
+
+def _torch_l2norm(f):
+    # reference featureL2Norm (lib/model.py:14-17): eps added to the sum
+    return f / torch.pow(torch.sum(torch.pow(f, 2), 1) + 1e-6, 0.5).unsqueeze(1)
+
+
+def _torch_correlation4d(fa, fb):
+    # reference FeatureCorrelation shape='4D' (lib/model.py:106-115)
+    b, c, ha, wa = fa.shape
+    hb, wb = fb.shape[2:]
+    mul = torch.bmm(fa.view(b, c, ha * wa).transpose(1, 2), fb.view(b, c, hb * wb))
+    return mul.view(b, ha, wa, hb, wb).unsqueeze(1)
+
+
+def _torch_mutual_matching(corr4d):
+    # reference MutualMatching (lib/model.py:155-175), eps 1e-5
+    b, ch, fs1, fs2, fs3, fs4 = corr4d.shape
+    corr_b = corr4d.view(b, fs1 * fs2, fs3, fs4)
+    corr_a = corr4d.view(b, fs1, fs2, fs3 * fs4)
+    b_max, _ = torch.max(corr_b, dim=1, keepdim=True)
+    a_max, _ = torch.max(corr_a, dim=3, keepdim=True)
+    eps = 1e-5
+    corr_b = (corr_b / (b_max + eps)).view_as(corr4d)
+    corr_a = (corr_a / (a_max + eps)).view_as(corr4d)
+    return corr4d * (corr_a * corr_b)
+
+
+def _torch_conv4d(x, w, bias):
+    """Reference conv4d tap decomposition (lib/conv4d.py:39-48): loop over
+    the leading kernel dim, conv3d per tap, bias once at the center tap."""
+    ksize = w.shape[2]
+    pad = ksize // 2
+    b, c, i, j, k, l = x.shape
+    cout = w.shape[0]
+    xpad = F.pad(x, (0, 0, 0, 0, 0, 0, pad, pad))
+    out = torch.zeros(b, cout, i, j, k, l)
+    for oi in range(i):
+        for p in range(ksize):
+            out[:, :, oi] += F.conv3d(
+                xpad[:, :, oi + p],
+                w[:, :, p],
+                bias=bias if p == pad else None,
+                padding=pad,
+            )
+    return out
+
+
+def _torch_neigh_consensus(corr4d, weights, biases):
+    def net(x):
+        for w, bb in zip(weights, biases):
+            x = F.relu(_torch_conv4d(x, w, bb))
+        return x
+
+    # symmetric mode (lib/model.py:144-150)
+    swapped = corr4d.permute(0, 1, 4, 5, 2, 3)
+    return net(corr4d) + net(swapped).permute(0, 1, 4, 5, 2, 3)
+
+
+# --------------------------------------------------------------------- tests
+
+
+def test_resnet101_full_trunk_parity():
+    sd = _resnet_sd()
+    params = convert_torch.convert_resnet101_trunk(sd, prefix="")
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 64, 64, 3).astype(np.float32)
+
+    got = np.asarray(resnet101_trunk_apply(params, jnp.asarray(x)))
+    want = (
+        _torch_resnet_trunk(sd, torch.from_numpy(x.transpose(0, 3, 1, 2)))
+        .numpy()
+        .transpose(0, 2, 3, 1)
+    )
+    assert got.shape == want.shape == (1, 4, 4, 1024)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_densenet201_full_trunk_parity():
+    sd = _densenet_sd()
+    params = convert_torch.convert_densenet201_trunk(sd, prefix="")
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 64, 64, 3).astype(np.float32)
+
+    got = np.asarray(densenet201_trunk_apply(params, jnp.asarray(x)))
+    want = (
+        _torch_densenet_trunk(sd, torch.from_numpy(x.transpose(0, 3, 1, 2)))
+        .numpy()
+        .transpose(0, 2, 3, 1)
+    )
+    assert got.shape == want.shape == (1, 4, 4, 256)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_densenet_conversion_structure_matches_init():
+    from ncnet_tpu.models.densenet import init_densenet201_trunk
+
+    sd = _densenet_sd()
+    converted = convert_torch.convert_densenet201_trunk(sd, prefix="")
+    ref = init_densenet201_trunk(jax.random.PRNGKey(0))
+    ref_flat, ref_tree = jax.tree.flatten(ref)
+    got_flat, got_tree = jax.tree.flatten(converted)
+    assert ref_tree == got_tree
+    for a, b in zip(ref_flat, got_flat):
+        assert np.shape(a) == np.shape(b)
+
+
+def test_densenet_legacy_zoo_key_names():
+    """The torchvision zoo densenet files use 'denselayerN.norm.1.weight'
+    style keys (regex-remapped by torchvision at load); the converter must
+    accept them identically to modern names."""
+    import re
+
+    sd = _densenet_sd()
+    legacy = {
+        re.sub(r"(denselayer\d+\.(?:norm|conv))(\d)\.", r"\1.\2.", k): v
+        for k, v in sd.items()
+    }
+    assert legacy.keys() != sd.keys()  # the rename actually did something
+    a = convert_torch.convert_densenet201_trunk(sd, prefix="")
+    b = convert_torch.convert_densenet201_trunk(legacy, prefix="")
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_full_immatchnet_pipeline_parity():
+    """Assembled model vs the torch reference math on identical weights —
+    the whole forward of lib/model.py:261-282 at the demo grid."""
+    from ncnet_tpu.models.immatchnet import ImMatchNetConfig, immatchnet_apply
+
+    trunk_sd = _resnet_sd()
+    g = torch.Generator().manual_seed(2)
+    ksizes, chans = (3, 3), (8, 1)
+    nc_weights, nc_biases, nc_sd = [], [], {}
+    cin = 1
+    for li, (k, cout) in enumerate(zip(ksizes, chans)):
+        w = torch.randn(cout, cin, k, k, k, k, generator=g) * (
+            1.0 / (cin * k**4) ** 0.5
+        )
+        bb = torch.randn(cout, generator=g) * 0.01
+        nc_weights.append(w)
+        nc_biases.append(bb)
+        # reference checkpoints store Conv4d weights PRE-PERMUTED
+        # (lib/conv4d.py:72-77): [k1, cout, cin, k2, k3, k4]
+        nc_sd[f"NeighConsensus.conv.{2 * li}.weight"] = w.permute(2, 0, 1, 3, 4, 5)
+        nc_sd[f"NeighConsensus.conv.{2 * li}.bias"] = bb
+        cin = cout
+
+    params = {
+        "feature_extraction": convert_torch.convert_resnet101_trunk(
+            trunk_sd, prefix=""
+        ),
+        "neigh_consensus": convert_torch.convert_neigh_consensus(nc_sd),
+    }
+    config = ImMatchNetConfig(ncons_kernel_sizes=ksizes, ncons_channels=chans)
+
+    rng = np.random.RandomState(2)
+    src = rng.randn(1, 64, 64, 3).astype(np.float32)
+    tgt = rng.randn(1, 64, 64, 3).astype(np.float32)
+
+    got = np.asarray(
+        immatchnet_apply(params, config, jnp.asarray(src), jnp.asarray(tgt))
+    )
+
+    ts, tt = (
+        torch.from_numpy(src.transpose(0, 3, 1, 2)),
+        torch.from_numpy(tgt.transpose(0, 3, 1, 2)),
+    )
+    fa = _torch_l2norm(_torch_resnet_trunk(trunk_sd, ts))
+    fb = _torch_l2norm(_torch_resnet_trunk(trunk_sd, tt))
+    corr = _torch_correlation4d(fa, fb)
+    corr = _torch_mutual_matching(corr)
+    corr = _torch_neigh_consensus(corr, nc_weights, nc_biases)
+    corr = _torch_mutual_matching(corr)
+    want = corr.squeeze(1).numpy()  # drop the channel axis like ours
+
+    assert got.shape == want.shape == (1, 4, 4, 4, 4)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
