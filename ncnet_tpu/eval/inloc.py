@@ -41,32 +41,59 @@ def _to_str(x):
     return str(x)
 
 
-def quantized_resize_shape(h, w, image_size, k_size):
+def quantized_resize_shape(h, w, image_size, k_size, grid_multiple=None):
     """The reference's resize rule (eval_inloc.py:84-89): max side ->
-    ``image_size``, then quantize so feature-grid dims divide by k_size."""
+    ``image_size``, then quantize so feature-grid dims divide by
+    ``grid_multiple`` (default: ``k_size``; the sharded path additionally
+    needs divisibility by the shard count)."""
+    m = grid_multiple if grid_multiple is not None else k_size
     ratio = max(h, w) / image_size
-    if k_size == 1:
+    if m <= 1:
         return int(h / ratio), int(w / ratio)
     s = SCALE_FACTOR
     return (
-        int(np.floor(h / ratio * s / k_size) / s * k_size),
-        int(np.floor(w / ratio * s / k_size) / s * k_size),
+        int(np.floor(h / ratio * s / m) / s * m),
+        int(np.floor(w / ratio * s / m) / s * m),
     )
 
 
-def load_and_preprocess(path, image_size, k_size):
+def load_and_preprocess(path, image_size, k_size, grid_multiple=None):
     img = load_image(path)
-    h, w = quantized_resize_shape(img.shape[0], img.shape[1], image_size, k_size)
+    h, w = quantized_resize_shape(
+        img.shape[0], img.shape[1], image_size, k_size, grid_multiple
+    )
     img = resize_bilinear_np(img, h, w)
     return normalize_image_np(img)[None]  # [1, h, w, 3]
 
 
-def make_match_fn(config):
-    """(params, src, tgt) -> (fwd, rev) match tuples for one pair (jittable)."""
+def make_match_fn(config, mesh=None):
+    """(params, src, tgt) -> (fwd, rev) match tuples for one pair (jittable).
+
+    With ``mesh`` (a Mesh with a 'spatial' axis), the correlation/NC
+    pipeline runs sharded over the A-grid rows via
+    `parallel.spatial.make_sharded_match_pipeline` — the high-res path for
+    grids whose corr4d exceeds a single chip's HBM (BASELINE config 5).
+    Feature grids must divide k_size x the shard count (use
+    ``grid_multiple`` in `load_and_preprocess`).
+    """
     k = config.relocalization_k_size
 
+    if mesh is None:
+        def forward(params, src, tgt):
+            return immatchnet_apply(params, config, src, tgt)
+    else:
+        from ncnet_tpu.models.immatchnet import extract_features
+        from ncnet_tpu.parallel.spatial import make_sharded_match_pipeline
+
+        pipeline = make_sharded_match_pipeline(config, mesh)
+
+        def forward(params, src, tgt):
+            feat_a = extract_features(params, config, src)
+            feat_b = extract_features(params, config, tgt)
+            return pipeline(params["neigh_consensus"], feat_a, feat_b)
+
     def fn(params, src, tgt):
-        out = immatchnet_apply(params, config, src, tgt)
+        out = forward(params, src, tgt)
         corr, delta4d = out if k > 1 else (out, None)
         kw = dict(scale="positive", do_softmax=True, delta4d=delta4d, k_size=max(k, 1))
         fwd = corr_to_matches(corr, **kw)
@@ -135,19 +162,28 @@ def dump_matches(
     both_directions=True,
     flip_direction=False,
     verbose=True,
+    mesh=None,
 ):
-    """Run the full dump. Writes ``<output_dir>/<q+1>.mat`` per query."""
+    """Run the full dump. Writes ``<output_dir>/<q+1>.mat`` per query.
+
+    ``mesh``: optional Mesh with a 'spatial' axis — shards the correlation
+    pipeline over A-grid rows for resolutions beyond single-chip HBM. The
+    resize quantization is widened so feature grids divide the shard count.
+    """
     from scipy.io import loadmat, savemat
 
     k_size = config.relocalization_k_size
     assert backbone_stride(config.feature_extraction_cnn) == int(1 / SCALE_FACTOR)
+    grid_multiple = None
+    if mesh is not None:
+        grid_multiple = max(k_size, 1) * mesh.shape["spatial"]
 
     dbmat = loadmat(shortlist_path)
     db = dbmat["ImgList"][0, :]
     pano_fn_all = np.vstack(tuple(db[q][1] for q in range(len(db))))
 
     os.makedirs(output_dir, exist_ok=True)
-    jitted = jax.jit(make_match_fn(config))
+    jitted = jax.jit(make_match_fn(config, mesh=mesh))
     stride = backbone_stride(config.feature_extraction_cnn)
 
     n_slots = n_match_slots(image_size, k_size, both_directions)
@@ -158,13 +194,17 @@ def dump_matches(
         matches = np.zeros((1, n_panos, n_slots, 5))
         query_fn = _to_str(db[q][0])
         src = jnp.asarray(
-            load_and_preprocess(os.path.join(query_path, query_fn), image_size, k_size)
+            load_and_preprocess(
+                os.path.join(query_path, query_fn), image_size, k_size,
+                grid_multiple,
+            )
         )
         for idx in range(n_panos):
             pano_fn = _to_str(db[q][1].ravel()[idx])
             tgt = jnp.asarray(
                 load_and_preprocess(
-                    os.path.join(pano_path, pano_fn), image_size, k_size
+                    os.path.join(pano_path, pano_fn), image_size, k_size,
+                    grid_multiple,
                 )
             )
             xa, ya, xb, yb, score = match_pair(

@@ -29,7 +29,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ncnet_tpu.ops.conv4d import conv4d
-from ncnet_tpu.ops.correlation import correlation_4d
+from ncnet_tpu.ops.correlation import correlation_4d, correlation_maxpool4d
 
 
 def _pmax(x, axis_name):
@@ -111,17 +111,26 @@ def neigh_consensus_sharded(params, corr, axis_name, symmetric=True, impl="xla")
 def make_sharded_match_pipeline(config, mesh, axis_name="spatial"):
     """Features -> filtered corr4d with the A grid sharded over ``axis_name``.
 
-    Returns a function ``(nc_params, feat_a, feat_b) -> corr4d`` where
-    ``feat_a`` is sharded over rows (dim 1) of the feature grid and the
-    output corr4d is sharded over iA. Relocalization is not supported on
-    the sharded path yet (the fused pool handles high-res instead).
+    Returns a function ``(nc_params, feat_a, feat_b) -> corr4d`` (or
+    ``-> (corr4d, (di, dj, dk, dl))`` when ``config.relocalization_k_size
+    > 1``) where ``feat_a`` is sharded over rows (dim 1) of the feature
+    grid and the outputs are sharded over (pooled) iA.
+
+    Relocalization composes with sharding because the fused
+    correlate+maxpool4d is LOCAL to an A-row slab (it needs only the slab
+    and the full B grid), provided each slab covers whole pooling cells —
+    hence the ``k_size``-aware divisibility checks below. The argmax
+    offsets are within-cell, so they shard alongside the pooled tensor.
     """
-    if config.relocalization_k_size > 1:
-        raise NotImplementedError("sharded pipeline with relocalization")
+    k = max(config.relocalization_k_size, 1)
     n_shards = mesh.shape[axis_name]
 
     def body(nc_params, feat_a, feat_b):
-        corr = correlation_4d(feat_a, feat_b)
+        deltas = None
+        if k > 1:
+            corr, deltas = correlation_maxpool4d(feat_a, feat_b, k)
+        else:
+            corr = correlation_4d(feat_a, feat_b)
         corr = mutual_matching_sharded(corr, axis_name)
         corr = neigh_consensus_sharded(
             nc_params,
@@ -131,26 +140,38 @@ def make_sharded_match_pipeline(config, mesh, axis_name="spatial"):
             impl=config.conv4d_impl,
         )
         corr = mutual_matching_sharded(corr, axis_name).astype(jnp.float32)
+        if k > 1:
+            return corr, deltas
         return corr
 
+    spec = P(None, axis_name)
+    out_specs = (spec, (spec, spec, spec, spec)) if k > 1 else spec
     mapped = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(), P(None, axis_name), P()),
-        out_specs=P(None, axis_name),
+        in_specs=(P(), spec, P()),
+        out_specs=out_specs,
         check_vma=False,
     )
 
     def pipeline(nc_params, feat_a, feat_b):
-        if feat_a.shape[1] % n_shards:
+        if feat_a.shape[1] % (n_shards * k):
             raise ValueError(
-                f"A-grid rows ({feat_a.shape[1]}) must divide the "
-                f"'{axis_name}' axis size ({n_shards})"
+                f"A-grid rows ({feat_a.shape[1]}) must divide "
+                f"{n_shards} shards x k_size {k} (each slab must cover "
+                "whole pooling cells)"
             )
-        if config.symmetric_mode and feat_b.shape[1] % n_shards:
+        if k > 1 and (
+            feat_a.shape[2] % k or feat_b.shape[1] % k or feat_b.shape[2] % k
+        ):
             raise ValueError(
-                "symmetric mode transposes A<->B, so B-grid rows "
-                f"({feat_b.shape[1]}) must also divide {n_shards} "
+                f"all feature-grid dims must divide k_size {k} for 4D "
+                f"pooling; got A {feat_a.shape[1:3]}, B {feat_b.shape[1:3]}"
+            )
+        if config.symmetric_mode and (feat_b.shape[1] // k) % n_shards:
+            raise ValueError(
+                "symmetric mode transposes A<->B, so pooled B-grid rows "
+                f"({feat_b.shape[1]} / {k}) must divide {n_shards} "
                 "(all_to_all resharding)"
             )
         return mapped(nc_params, feat_a, feat_b)

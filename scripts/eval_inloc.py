@@ -28,6 +28,10 @@ def main():
     p.add_argument("--pano_path", type=str, default="datasets/inloc/pano/")
     p.add_argument("--query_path", type=str, default="datasets/inloc/query/iphone7/")
     p.add_argument("--output_root", type=str, default="matches")
+    p.add_argument("--spatial_shards", type=int, default=0,
+                   help="shard the correlation pipeline over this many "
+                        "devices ('spatial' mesh axis) for grids beyond "
+                        "single-chip HBM; 0 = unsharded")
     args = p.parse_args()
 
     if args.checkpoint.endswith((".pth.tar", ".pth")):
@@ -59,6 +63,23 @@ def main():
     out_dir = os.path.join(args.output_root, exp)
     print(f"Output matches folder: {out_dir}")
 
+    mesh = None
+    if args.spatial_shards > 1:
+        import jax
+
+        from ncnet_tpu.parallel.mesh import make_mesh
+
+        n_dev = len(jax.devices())
+        if args.spatial_shards > n_dev:
+            p.error(
+                f"--spatial_shards {args.spatial_shards} exceeds the "
+                f"{n_dev} available device(s)"
+            )
+        mesh = make_mesh(
+            (args.spatial_shards,), ("spatial",),
+            devices=jax.devices()[: args.spatial_shards],
+        )
+
     from ncnet_tpu.eval.inloc import dump_matches
 
     dump_matches(
@@ -74,6 +95,7 @@ def main():
         both_directions=args.matching_both_directions,
         flip_direction=args.flip_matching_direction
         and not args.matching_both_directions,
+        mesh=mesh,
     )
 
 

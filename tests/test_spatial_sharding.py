@@ -41,3 +41,52 @@ def test_sharded_pipeline_symmetric_square():
     want = np.asarray(match_pipeline(params["neigh_consensus"], CFG, fa, fb))
     got = np.asarray(make_sharded_match_pipeline(CFG, mesh)(params["neigh_consensus"], fa, fb))
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_sharded_pipeline_with_relocalization():
+    """Sharded fused correlate+maxpool4d: pooled corr AND argmax deltas
+    must agree with the unsharded pipeline (the InLoc high-res config)."""
+    cfg = CFG.replace(relocalization_k_size=2)
+    mesh = make_mesh((2,), ("spatial",), devices=jax.devices()[:2])
+    params = init_immatchnet(jax.random.PRNGKey(2), cfg)
+    rng = np.random.RandomState(2)
+    # A rows = 8: divisible by 2 shards x k=2; pooled B rows 4 % 2 == 0
+    fa = jnp.asarray(rng.randn(1, 8, 6, 8).astype(np.float32))
+    fb = jnp.asarray(rng.randn(1, 8, 6, 8).astype(np.float32))
+
+    want_corr, want_d = match_pipeline(params["neigh_consensus"], cfg, fa, fb)
+    got_corr, got_d = make_sharded_match_pipeline(cfg, mesh)(
+        params["neigh_consensus"], fa, fb
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_corr), np.asarray(want_corr), rtol=1e-4, atol=1e-5
+    )
+    for g, w in zip(got_d, want_d):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_inloc_match_fn_sharded_agrees_with_unsharded():
+    """End-to-end InLoc surface (BASELINE config-5 shaped): make_match_fn
+    with a spatial mesh produces the same match lists as single-device."""
+    from ncnet_tpu.eval.inloc import make_match_fn
+
+    cfg = ImMatchNetConfig(
+        feature_extraction_cnn="vgg",
+        ncons_kernel_sizes=(3, 3),
+        ncons_channels=(4, 1),
+        relocalization_k_size=2,
+    )
+    mesh = make_mesh((2,), ("spatial",), devices=jax.devices()[:2])
+    params = init_immatchnet(jax.random.PRNGKey(3), cfg)
+    rng = np.random.RandomState(3)
+    # 128x96 -> vgg stride 16 -> grid 8x6; aspect-rectangular like InLoc
+    src = jnp.asarray(rng.randn(1, 128, 96, 3).astype(np.float32))
+    tgt = jnp.asarray(rng.randn(1, 128, 128, 3).astype(np.float32))
+
+    want = make_match_fn(cfg)(params, src, tgt)
+    got = make_match_fn(cfg, mesh=mesh)(params, src, tgt)
+    for w_dir, g_dir in zip(want, got):
+        for w, g in zip(w_dir, g_dir):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=1e-4, atol=1e-5
+            )
