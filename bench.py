@@ -13,6 +13,14 @@ peak so absurd numbers are self-evident: analytic FLOPs per step are
 derived from the config below (the 25^4 x 5^4 NC convolutions dominate:
 conv2 alone is ~125 GFLOP/pair/direction).
 
+Measured formulation ceiling (round 2, v5e): the NC convolutions cap at
+~20-30 TFLOP/s f+b across every lowering tried (direct rank-4, tap sums,
+channel-fused conv2d 'cf'/'cfs', im2col GEMM, Toeplitz 'tlc'); only
+5x-FLOP-inflated wide-lane forms reach >130 TFLOP/s hardware rate, netting
+~26 useful — the 16-channel, 25-grid shapes are the binding constraint.
+Best known config: cfs + loss_chunk 4 + chunk remat with the 'nc_conv'
+save-policy (convs not recomputed in backward).
+
 Baseline: the reference repo publishes no throughput numbers (BASELINE.md).
 ``V100_EST_PAIRS_PER_SEC`` is an analytic estimate for the reference
 implementation on a single V100 at the PF-Pascal training config (batch 16,
@@ -55,8 +63,11 @@ def train_step_flops(batch, grid=25, feat_ch=1024, image=400):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--conv4d_impl", default="cf")
+    p.add_argument("--conv4d_impl", default="cfs")
     p.add_argument("--nc_remat", action="store_true")
+    p.add_argument("--no_chunk_remat", action="store_true",
+                   help="disable per-chunk rematerialization (needs the "
+                        "packed-layout residuals to fit in HBM)")
     p.add_argument("--loss_chunk", type=int, default=4)
     p.add_argument("--batch", type=int, default=16)
     p.add_argument("--steps", type=int, default=10)
@@ -80,6 +91,7 @@ def main():
         conv4d_impl=args.conv4d_impl,
         nc_remat=args.nc_remat,
         loss_chunk=args.loss_chunk,
+        loss_chunk_remat=not args.no_chunk_remat,
     )
     params = init_immatchnet(jax.random.PRNGKey(0), config)
     optimizer = make_optimizer()

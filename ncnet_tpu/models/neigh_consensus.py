@@ -9,8 +9,9 @@ single pass with symmetrized filters, which is why both passes are needed.
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
-from ncnet_tpu.ops.conv4d import conv4d, conv4d_packed
+from ncnet_tpu.ops.conv4d import conv4d_packed
 
 
 def init_neigh_consensus(rng, kernel_sizes=(3, 3, 3), channels=(10, 10, 1)):
@@ -69,13 +70,21 @@ def neigh_consensus_apply(params, corr, symmetric=True, impl="xla", remat=False)
       params: from `init_neigh_consensus`.
       corr: ``[b, iA, jA, iB, jB]`` (no channel axis).
       symmetric: reference ``symmetric_mode`` (default True).
-      impl: conv4d implementation ('xla' | 'taps' | 'scan').
-      remat: rematerialize each layer in the backward pass. The remat
-        boundary is placed around the pack->unpack->conv->relu->pack unit, so
-        only PACKED activations (see `_pack`) survive between forward and
-        backward — without this, XLA keeps channels-minor 6D activations
-        whose TPU tiling pads HBM 8x and training OOMs at the reference's
-        batch 16 (measured on v5e).
+      impl: conv4d implementation (see `ops.conv4d.conv4d`).
+      remat: additionally rematerialize each layer in the backward pass
+        (saves the inter-layer activations' backward residuals at the cost
+        of re-running each layer's forward).
+
+    The stack ALWAYS runs on the packed ``[b, i, j, k*l*c]`` layout between
+    layers: every inter-layer activation and relu mask that XLA saves for
+    the backward pass is packed (~1% TPU tiling padding), whereas
+    channels-minor 6D tensors pad 8-10x in HBM — the measured OOM cause at
+    the reference's batch-16 config on a 16G v5e. Inside a conv the 6D view
+    reappears only as reshapes fused into the convolution itself.
+
+    The symmetric pass runs as ONE batched net application on
+    ``concat([x, T(x)])`` (identical math to ``net(x) + T(net(T(x)))`` —
+    the net is per-sample — at twice the GEMM batch).
 
     Returns:
       ``[b, iA, jA, iB, jB]`` (final layer must have 1 output channel).
@@ -83,47 +92,43 @@ def neigh_consensus_apply(params, corr, symmetric=True, impl="xla", remat=False)
 
     dtype = corr.dtype
 
-    def layer(x, p):
+    def packed_layer(xp, p, kl):
         # params follow the activation dtype (the reference casts NC
         # weights to half in fp16 mode, lib/model.py:253-258)
-        return jax.nn.relu(
-            conv4d(x, p["kernel"].astype(dtype), p["bias"].astype(dtype), impl=impl)
+        y = conv4d_packed(
+            xp,
+            p["kernel"].astype(dtype),
+            kl,
+            p["bias"].astype(dtype),
+            impl=impl,
         )
+        # named for jax.checkpoint save-policies: an outer remat (the loss
+        # chunking) can save exactly these conv outputs and recompute only
+        # the cheap elementwise rest in the backward pass (train/loss.py)
+        y = checkpoint_name(y, "nc_conv")
+        return jax.nn.relu(y)
 
-    if remat:
-        # Fully packed pipeline: convs, relus and the remat boundaries all
-        # live in the [b, i, j, c, k*l] layout; nothing full-size is ever
-        # materialized channels-minor.
-        def packed_layer(xp, p, kl):
-            return jax.nn.relu(
-                conv4d_packed(
-                    xp,
-                    p["kernel"].astype(dtype),
-                    kl,
-                    p["bias"].astype(dtype),
-                    impl=impl,
-                )
-            )
+    layer_fn = (
+        jax.checkpoint(packed_layer, static_argnums=(2,)) if remat
+        else packed_layer
+    )
 
-        remat_layer = jax.checkpoint(packed_layer, static_argnums=(2,))
-
-        def net(x):
-            kl = (x.shape[3], x.shape[4])
-            xp = _pack(x)
-            for p in params:
-                xp = remat_layer(xp, p, kl)
-            return _unpack(xp, *kl)
-
-    else:
-
-        def net(x):
-            for p in params:
-                x = layer(x, p)
-            return x
+    def net(x):
+        kl = (x.shape[3], x.shape[4])
+        xp = _pack(x)
+        for p in params:
+            xp = layer_fn(xp, p, kl)
+        return _unpack(xp, *kl)
 
     x = corr[..., None]
     if symmetric:
-        out = net(x) + _swap_ab(net(_swap_ab(x)))
+        xt = _swap_ab(x)
+        if x.shape == xt.shape:
+            b = x.shape[0]
+            y = net(jnp.concatenate([x, xt], axis=0))
+            out = y[:b] + _swap_ab(y[b:])
+        else:  # rectangular A/B grids (eval pairs) can't batch the swap
+            out = net(x) + _swap_ab(net(xt))
     else:
         out = net(x)
     if out.shape[-1] != 1:

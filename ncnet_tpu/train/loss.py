@@ -93,7 +93,17 @@ def weak_loss(params, config, batch, normalization="softmax"):
         )
         chunk_fn = lambda t: pair_scores(*t)
         if getattr(config, "loss_chunk_remat", True):
-            chunk_fn = jax.checkpoint(chunk_fn)
+            # Save the NC conv outputs (tagged 'nc_conv' in
+            # neigh_consensus_apply) across the remat boundary: the
+            # backward pass then re-runs only the cheap elementwise ops
+            # (MM ratios, relu, softmax scores), not the convolutions —
+            # the convs are ~98% of the chunk's forward FLOPs.
+            chunk_fn = jax.checkpoint(
+                chunk_fn,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "nc_conv"
+                ),
+            )
         pos, neg = lax.map(chunk_fn, chunks)
         score_pos, score_neg = jnp.mean(pos), jnp.mean(neg)
     else:

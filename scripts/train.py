@@ -55,7 +55,7 @@ def main():
     p.add_argument("--profile_dir", type=str, default="",
                    help="capture a jax.profiler trace of a few early steps "
                         "into this directory")
-    p.add_argument("--conv4d_impl", type=str, default="cf",
+    p.add_argument("--conv4d_impl", type=str, default="cfs",
                    choices=["xla", "taps", "scan", "tlc", "tf3", "tf2",
                             "cf", "cfs", "gemm", "gemms", "pallas"])
     args = p.parse_args()

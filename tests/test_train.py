@@ -1,5 +1,7 @@
 import jax
 import jax.numpy as jnp
+import os
+
 import numpy as np
 
 from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
@@ -131,3 +133,62 @@ def test_checkpoint_resume_with_opt_state(tmp_path):
     chex.assert_trees_all_close(
         loaded.opt_state, jax.tree.map(np.asarray, state.opt_state)
     )
+
+
+def test_chunked_loss_with_save_policy_matches_unchunked():
+    """The loss_chunk + save_only_these_names('nc_conv') remat path must be
+    a pure performance transform: loss AND gradients identical to the
+    unchunked path (locks in the checkpoint_name contract between
+    train/loss.py and neigh_consensus_apply)."""
+    cfg_chunked = CFG.replace(loss_chunk=2, loss_chunk_remat=True)
+    params = init_immatchnet(jax.random.PRNGKey(5), CFG)
+    batch = _batch(np.random.RandomState(5), b=4)
+
+    def loss_of(cfg):
+        def f(nc):
+            p = dict(params)
+            p["neigh_consensus"] = nc
+            return weak_loss(p, cfg, batch)
+
+        return f
+
+    l_plain = float(weak_loss(params, CFG, batch))
+    l_chunk = float(weak_loss(params, cfg_chunked, batch))
+    np.testing.assert_allclose(l_chunk, l_plain, rtol=1e-5, atol=1e-8)
+
+    g_plain = jax.grad(loss_of(CFG))(params["neigh_consensus"])
+    g_chunk = jax.grad(loss_of(cfg_chunked))(params["neigh_consensus"])
+    for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_chunk)):
+        # atol covers f32 reduction-order noise on ~1e-4 magnitude grads
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-7
+        )
+
+
+def test_synthetic_convergence_slow():
+    """End-to-end learning proof (VERDICT r1 item 3): loss decreases and
+    the synthetic keypoint-transfer PCK improves over training. Slow
+    (~minutes); opt in with NCNET_RUN_SLOW=1. The driver-runnable form is
+    scripts/synthetic_convergence.py."""
+    import pytest
+
+    if not os.environ.get("NCNET_RUN_SLOW"):
+        pytest.skip("slow test; set NCNET_RUN_SLOW=1")
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "synthetic_convergence",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts",
+            "synthetic_convergence.py",
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = mod.run(
+        image_size=96, steps=60, batch=4, n_pairs=16, log_every=20,
+        verbose=False,
+    )
+    assert out["loss_last"] < out["loss_first"]
+    assert out["pck_after"] > out["pck_before"]
