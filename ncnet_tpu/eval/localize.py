@@ -1,0 +1,347 @@
+"""InLoc localization stage: PnP-RANSAC pose estimation from dumped matches.
+
+Python port of the reference's MATLAB L6 pipeline (SURVEY.md §2.4) so the
+whole benchmark runs without MATLAB:
+
+  * per-(query, pano) pose estimation — `pnp_localize_pair` mirrors
+    lib_matlab/parfor_NC4D_PE_pnponly.m: threshold matches by score > 0.75,
+    scale normalized coords to pixels (floor + zero-fix for the DB side,
+    :44-49), back-project DB pixels to 3D via the RGBD cutout ``XYZcut``
+    (:57-61), apply the scan alignment transform, drop NaNs, then P3P
+    LO-RANSAC with an angular inlier threshold (0.2 deg, :77);
+  * `p3p_grunert` — the minimal 3-point absolute-pose solver (Grunert's
+    quartic, as surveyed by Haralick et al.), replacing the external
+    ``ht_lo_ransac_p3p`` dependency;
+  * `pose_distance` — lib_matlab/p2dist.m: camera-center L2 +
+    rotation-geodesic angle (p2c.m for the center);
+  * `localization_rate_curve` — lib_matlab/ht_plotcurve_WUSTL.m:76-93: %
+    of queries with position error under a sweep of thresholds (0..2 m),
+    orientation error gated at 10 degrees.
+
+The dense pose-verification re-ranking stage (parfor_nc4d_PV.m: render
+synthetic views from the scan, DSIFT similarity) depends on the raw laser
+scans + vl_phow and is NOT ported; this module covers the "DensePE +
+NCNet" (PnP-only) curve.
+
+Pure numpy — this is a host-side geometric solver, not an accelerator
+workload (the reference runs it on CPU via MATLAB parfor; parallelize over
+queries with multiprocessing if needed).
+"""
+
+import numpy as np
+
+
+# ----------------------------------------------------------- minimal solvers
+
+
+def p3p_grunert(rays, points):
+    """Absolute pose from 3 ray/point correspondences (Grunert 1841).
+
+    Args:
+      rays: ``[3, 3]`` bearing vectors in the camera frame (rows; need not
+        be normalized).
+      points: ``[3, 3]`` corresponding world points (rows).
+
+    Returns:
+      List of ``[3, 4]`` poses ``P = [R | t]`` with ``x_cam = R x_world + t``
+      (up to 4 real solutions; empty on degeneracy).
+    """
+    f = rays / np.linalg.norm(rays, axis=1, keepdims=True)
+    X1, X2, X3 = points
+    a = np.linalg.norm(X2 - X3)  # side opposite point 1
+    b = np.linalg.norm(X1 - X3)
+    c = np.linalg.norm(X1 - X2)
+    if min(a, b, c) < 1e-12:
+        return []
+    cos_a = float(f[1] @ f[2])
+    cos_b = float(f[0] @ f[2])
+    cos_g = float(f[0] @ f[1])
+
+    a2, b2, c2 = a * a, b * b, c * c
+    # Grunert's quartic in v = s3/s1 (Haralick et al., RPP survey, eq. set)
+    q = (a2 - c2) / b2
+    A4 = (q - 1.0) ** 2 - 4.0 * (c2 / b2) * cos_a**2
+    A3 = 4.0 * (
+        q * (1.0 - q) * cos_b
+        - (1.0 - (a2 + c2) / b2) * cos_a * cos_g
+        + 2.0 * (c2 / b2) * cos_a**2 * cos_b
+    )
+    A2 = 2.0 * (
+        q**2
+        - 1.0
+        + 2.0 * q**2 * cos_b**2
+        + 2.0 * ((b2 - c2) / b2) * cos_a**2
+        - 4.0 * ((a2 + c2) / b2) * cos_a * cos_b * cos_g
+        + 2.0 * ((b2 - a2) / b2) * cos_g**2
+    )
+    A1 = 4.0 * (
+        -q * (1.0 + q) * cos_b
+        + 2.0 * (a2 / b2) * cos_g**2 * cos_b
+        - (1.0 - (a2 + c2) / b2) * cos_a * cos_g
+    )
+    A0 = (1.0 + q) ** 2 - 4.0 * (a2 / b2) * cos_g**2
+
+    coeffs = np.array([A4, A3, A2, A1, A0])
+    if not np.all(np.isfinite(coeffs)) or abs(A4) < 1e-14:
+        return []
+    roots = np.roots(coeffs)
+    poses = []
+    for v in roots:
+        if abs(v.imag) > 1e-8 or v.real <= 0:
+            continue
+        v = float(v.real)
+        denom = 2.0 * (cos_g - v * cos_a)
+        if abs(denom) < 1e-12:
+            continue
+        u = ((q - 1.0) * v * v - 2.0 * q * cos_b * v + 1.0 + q) / denom
+        if u <= 0:
+            continue
+        s1sq = b2 / (1.0 + v * v - 2.0 * v * cos_b)
+        if s1sq <= 0:
+            continue
+        s1 = float(np.sqrt(s1sq))
+        s2, s3 = u * s1, v * s1
+        cam_pts = np.stack([s1 * f[0], s2 * f[1], s3 * f[2]])
+        P = _absolute_orientation(points, cam_pts)
+        if P is not None:
+            poses.append(P)
+    return poses
+
+
+def _absolute_orientation(world_pts, cam_pts):
+    """Rigid transform ``x_cam = R x_world + t`` (Kabsch, no scale)."""
+    cw = world_pts.mean(axis=0)
+    cc = cam_pts.mean(axis=0)
+    H = (world_pts - cw).T @ (cam_pts - cc)
+    U, _, Vt = np.linalg.svd(H)
+    d = np.sign(np.linalg.det(Vt.T @ U.T))
+    R = Vt.T @ np.diag([1.0, 1.0, d]) @ U.T
+    if not np.all(np.isfinite(R)):
+        return None
+    t = cc - R @ cw
+    return np.concatenate([R, t[:, None]], axis=1)
+
+
+def dlt_pnp(rays, points):
+    """Direct linear transform PnP (>= 6 points) for the LO refit.
+
+    Solves for P=[R|t] minimizing the algebraic cross-product error
+    ``ray x (R X + t) = 0``, then projects onto SO(3).
+    """
+    n = len(points)
+    if n < 6:
+        return None
+    f = rays / np.linalg.norm(rays, axis=1, keepdims=True)
+    A = np.zeros((2 * n, 12))
+    for i in range(n):
+        X = np.append(points[i], 1.0)
+        x, y, z = f[i]
+        # two independent rows of [f]_x * [X' 0 0; 0 X' 0; 0 0 X'] P_vec
+        A[2 * i, 0:4] = -z * X
+        A[2 * i, 8:12] = x * X
+        A[2 * i + 1, 4:8] = -z * X
+        A[2 * i + 1, 8:12] = y * X
+    _, _, Vt = np.linalg.svd(A)
+    P = Vt[-1].reshape(3, 4)
+    # The SVD null vector's sign is arbitrary; resolve it BEFORE the SO(3)
+    # projection (the closest rotation to -sigma*R is unrelated to R — a
+    # wrong pose in ~half of solves if skipped).
+    if np.linalg.det(P[:, :3]) < 0:
+        P = -P
+    U, s, Vt2 = np.linalg.svd(P[:, :3])
+    R = U @ Vt2  # det is +1 since det(P[:, :3]) > 0
+    scale = s.mean()
+    if scale < 1e-12:
+        return None
+    t = P[:, 3] / scale
+    # cheirality: points must be in front of the camera; a violation means
+    # the algebraic solution is a mirror configuration — reject it
+    Xc = (R @ points.T + t[:, None]).T
+    if np.median(np.sum(Xc * f, axis=1)) < 0:
+        return None
+    return np.concatenate([R, t[:, None]], axis=1)
+
+
+def _angular_inliers(P, rays, points, cos_thr):
+    f = rays / np.linalg.norm(rays, axis=1, keepdims=True)
+    Xc = (P[:, :3] @ points.T + P[:, 3:4]).T
+    norms = np.linalg.norm(Xc, axis=1)
+    ok = norms > 1e-12
+    cosang = np.zeros(len(points))
+    cosang[ok] = np.sum(f[ok] * Xc[ok], axis=1) / norms[ok]
+    return cosang > cos_thr
+
+
+def lo_ransac_p3p(rays, points, thr_rad, max_iters=10000, seed=0,
+                  confidence=0.999):
+    """Locally-optimized RANSAC over P3P (the ``ht_lo_ransac_p3p`` role:
+    parfor_NC4D_PE_pnponly.m:77).
+
+    Args:
+      rays: ``[n, 3]`` camera-frame bearing vectors.
+      points: ``[n, 3]`` world points.
+      thr_rad: angular inlier threshold in radians (reference: 0.2 deg).
+      max_iters: hypothesis cap (reference: 10000; adaptive early exit).
+
+    Returns:
+      ``(P, inliers)`` — best ``[3, 4]`` pose and boolean mask, or
+      ``(None, zeros)`` if no model found.
+    """
+    n = len(points)
+    empty = np.zeros(n, bool)
+    if n < 3:
+        return None, empty
+    rng = np.random.RandomState(seed)
+    cos_thr = np.cos(thr_rad)
+    best_P, best_inl = None, empty
+    it, needed = 0, max_iters
+    while it < min(max_iters, needed):
+        it += 1
+        sel = rng.choice(n, 3, replace=False)
+        for P in p3p_grunert(rays[sel], points[sel]):
+            inl = _angular_inliers(P, rays, points, cos_thr)
+            if inl.sum() > best_inl.sum():
+                best_P, best_inl = P, inl
+                # local optimization: refit on inliers, re-collect
+                for _ in range(2):
+                    if best_inl.sum() >= 6:
+                        P_lo = dlt_pnp(rays[best_inl], points[best_inl])
+                        if P_lo is None:
+                            break
+                        inl_lo = _angular_inliers(P_lo, rays, points, cos_thr)
+                        if inl_lo.sum() >= best_inl.sum():
+                            best_P, best_inl = P_lo, inl_lo
+                        else:
+                            break
+                w = best_inl.sum() / n
+                if w > 0:
+                    denom = np.log(max(1.0 - w**3, 1e-12))
+                    needed = int(np.ceil(np.log(1 - confidence) / denom))
+    return best_P, best_inl
+
+
+# ------------------------------------------------- per-pair pose estimation
+
+
+def pnp_localize_pair(
+    matches,
+    query_size,
+    db_size,
+    xyz_cut,
+    focal_length,
+    alignment=None,
+    score_thr=0.75,
+    pnp_thr_deg=0.2,
+    n_subsample=None,
+    max_iters=10000,
+    seed=0,
+):
+    """Pose of a query camera from dense matches against one RGBD cutout.
+
+    Mirrors parfor_NC4D_PE_pnponly.m end to end. Args:
+
+      matches: ``[N, 5]`` rows ``(xA, yA, xB, yB, score)`` in normalized
+        [0, 1] coords (the .mat dump contract; A = query, B = DB cutout).
+      query_size: (h, w) of the query image.
+      db_size: (h, w) of the cutout (``XYZcut`` grid).
+      xyz_cut: ``[h, w, 3]`` per-pixel 3D points (NaN where invalid).
+      focal_length: query focal length in pixels (params.data.q.fl).
+      alignment: optional ``[3, 4]`` or ``[4, 4]`` scan-to-global transform
+        (``P_after`` of load_WUSTL_transformation); identity if None.
+      score_thr: reference ``params.ncnet.thr`` = 0.75.
+      pnp_thr_deg: reference ``params.ncnet.pnp_thr`` = 0.2 deg.
+      n_subsample: optional cap on tentatives (params.ncnet.N_subsample).
+
+    Returns:
+      dict with ``P`` ([3,4] or None), ``inliers``, ``tentatives_2d``
+      ([4, n]: query px; db px), ``tentatives_3d`` ([6, n]: ray; 3D).
+    """
+    m = np.asarray(matches, np.float64)
+    m = m[m[:, 4] > score_thr]
+    if n_subsample is not None and len(m) > n_subsample:
+        sel = np.random.RandomState(seed).permutation(len(m))[:n_subsample]
+        m = m[sel]
+    qh, qw = query_size
+    dh, dw = db_size
+
+    # feature upsampling (:44-49): query scales continuously; DB floors to
+    # integer pixels with 0 -> 1 (MATLAB 1-indexed)
+    xq = m[:, 0] * qw
+    yq = m[:, 1] * qh
+    xdb = np.floor(m[:, 2] * dw)
+    ydb = np.floor(m[:, 3] * dh)
+    xdb[xdb == 0] = 1
+    ydb[ydb == 0] = 1
+
+    # query rays through Kq^-1 (:52-55)
+    rays = np.stack(
+        [
+            (xq - qw / 2.0) / focal_length,
+            (yq - qh / 2.0) / focal_length,
+            np.ones_like(xq),
+        ],
+        axis=1,
+    )
+
+    # DB 3D points from the cutout (1-indexed pixel -> 0-indexed array)
+    xyz = np.asarray(xyz_cut, np.float64)
+    pts3d = xyz[
+        np.clip(ydb.astype(int) - 1, 0, dh - 1),
+        np.clip(xdb.astype(int) - 1, 0, dw - 1),
+    ]
+    if alignment is not None:
+        A = np.asarray(alignment, np.float64)
+        pts3d = pts3d @ A[:3, :3].T + A[:3, 3]
+
+    valid = np.all(np.isfinite(pts3d), axis=1)
+    rays, pts3d = rays[valid], pts3d[valid]
+    xq, yq, xdb, ydb = xq[valid], yq[valid], xdb[valid], ydb[valid]
+
+    out = {
+        "tentatives_2d": np.stack([xq, yq, xdb, ydb]),
+        "tentatives_3d": np.concatenate([rays.T, pts3d.T]),
+    }
+    if len(pts3d) < 3:
+        out["P"], out["inliers"] = None, np.zeros(len(pts3d), bool)
+        return out
+    P, inl = lo_ransac_p3p(
+        rays, pts3d, np.deg2rad(pnp_thr_deg), max_iters=max_iters, seed=seed
+    )
+    out["P"], out["inliers"] = P, inl
+    return out
+
+
+# ----------------------------------------------------------- metric + curve
+
+
+def camera_center(P):
+    """``p2c.m``: C = -R' t."""
+    P = np.asarray(P, np.float64)
+    return -P[:3, :3].T @ P[:3, 3]
+
+
+def pose_distance(P1, P2):
+    """``p2dist.m``: (center L2 distance, rotation geodesic angle rad)."""
+    d_pos = float(np.linalg.norm(camera_center(P1) - camera_center(P2)))
+    R = np.linalg.solve(np.asarray(P1, np.float64)[:3, :3],
+                        np.asarray(P2, np.float64)[:3, :3])
+    c = (np.trace(R) - 1.0) / 2.0
+    d_ori = float(np.arccos(np.clip(c, -1.0, 1.0)))
+    return d_pos, d_ori
+
+
+def localization_rate_curve(pos_err, ori_err_rad, max_ori_deg=10.0):
+    """``ht_plotcurve_WUSTL.m:76-93``: localized-% vs distance threshold.
+
+    Returns ``(thresholds_m, rate_percent)`` with the reference's
+    threshold grid (0:0.0625:1 then 1.125:0.125:2) and the 10-degree
+    orientation gate.
+    """
+    pos = np.asarray(pos_err, np.float64).copy()
+    ori = np.rad2deg(np.asarray(ori_err_rad, np.float64))
+    pos[ori > max_ori_deg] = np.inf
+    thr = np.concatenate(
+        [np.arange(0.0, 1.0 + 1e-9, 0.0625), np.arange(1.125, 2.0 + 1e-9, 0.125)]
+    )
+    rate = (pos[:, None] < thr[None, :]).mean(axis=0) * 100.0
+    return thr, rate

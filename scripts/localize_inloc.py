@@ -1,0 +1,174 @@
+"""InLoc PnP localization CLI — the MATLAB stage as one Python command.
+
+Equivalent to compute_densePE_NCNet.m -> ir_top100_NC4D_localization_pnponly.m
+(PnP-only path): for every query in the shortlist, load the matches dumped
+by scripts/eval_inloc.py, estimate a pose per top-N pano with P3P
+LO-RANSAC (ncnet_tpu.eval.localize), and — when ground-truth poses are
+provided — print the localization-rate curve
+(ht_plotcurve_WUSTL.m semantics: position threshold sweep 0..2 m,
+orientation gated at 10 deg).
+
+Data layout mirrors the InLoc distribution: RGBD cutouts as .mat files
+containing ``XYZcut`` [h, w, 3]; scan alignment transforms as text files
+whose last 4 whitespace-separated lines hold the 4x4 local-to-global
+matrix (load_WUSTL_transformation's ``P_after``).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=64)
+def load_cutout(path):
+    """Cached cutout loader: the 356 queries' top-10 shortlists overlap
+    heavily, so caching cuts thousands of multi-MB loadmat calls down to
+    the number of distinct cutouts."""
+    from scipy.io import loadmat
+
+    return loadmat(path)["XYZcut"]
+
+
+@functools.lru_cache(maxsize=256)
+def load_alignment(path):
+    """Last 4 numeric rows of the transformation txt -> [4, 4] P_after."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            vals = line.split()
+            if len(vals) == 4:
+                try:
+                    rows.append([float(v) for v in vals])
+                except ValueError:
+                    rows = []
+    if len(rows) < 4:
+        raise ValueError(f"no 4x4 transform found in {path}")
+    return np.asarray(rows[-4:], np.float64)
+
+
+def main():
+    from scipy.io import loadmat
+
+    from ncnet_tpu.eval.inloc import _to_str
+    from ncnet_tpu.eval.localize import (
+        localization_rate_curve,
+        pnp_localize_pair,
+        pose_distance,
+    )
+
+    p = argparse.ArgumentParser(description="InLoc PnP localization")
+    p.add_argument("--matches_dir", required=True,
+                   help="matches/<experiment> dir from scripts/eval_inloc.py")
+    p.add_argument("--shortlist", required=True)
+    p.add_argument("--cutout_dir", required=True,
+                   help="dir of RGBD cutout .mat files (XYZcut)")
+    p.add_argument("--transform_dir", default="",
+                   help="dir of per-scan alignment txt files; empty = "
+                        "identity (cutouts already global)")
+    p.add_argument("--query_dir", required=True)
+    p.add_argument("--focal", type=float, default=4032 * 28.0 / 36.0,
+                   help="query focal length in pixels (iPhone 7 default)")
+    p.add_argument("--n_queries", type=int, default=356)
+    p.add_argument("--n_panos", type=int, default=10)
+    p.add_argument("--score_thr", type=float, default=0.75)
+    p.add_argument("--pnp_thr_deg", type=float, default=0.2)
+    p.add_argument("--refposes", default="",
+                   help=".mat with DUC1_RefList/DUC2_RefList GT poses; "
+                        "prints the localization curve when given")
+    p.add_argument("--out", default="localization.json")
+    args = p.parse_args()
+
+    from PIL import Image
+
+    db = loadmat(args.shortlist)["ImgList"][0, :]
+    results = []
+    for q in range(min(args.n_queries, len(db))):
+        match_path = os.path.join(args.matches_dir, f"{q + 1}.mat")
+        if not os.path.exists(match_path):
+            print(f"skip query {q + 1}: {match_path} missing", flush=True)
+            continue
+        matches = loadmat(match_path)["matches"]  # [1, Npanos, N, 5]
+        query_fn = _to_str(db[q][0])
+        with Image.open(os.path.join(args.query_dir, query_fn)) as im:
+            qw, qh = im.size
+        entry = {"queryname": query_fn, "topNname": [], "P": []}
+        for idx in range(min(args.n_panos, matches.shape[1])):
+            pano_fn = _to_str(db[q][1].ravel()[idx])
+            cutout = load_cutout(
+                os.path.join(args.cutout_dir, pano_fn + ".mat")
+            )
+            align = None
+            if args.transform_dir:
+                floor = pano_fn.split("/")[0]
+                base = os.path.basename(pano_fn)
+                # cutout names are '<scene>_cutout_<scan>_<yaw>_<pitch>.jpg'
+                # (parse_WUSTL_cutoutname): scene token 0, scan token 2
+                parts = base.split("_")
+                scene_id, scan_id = parts[0], parts[2]
+                align = load_alignment(
+                    os.path.join(
+                        args.transform_dir, floor, "transformations",
+                        f"{scene_id}_trans_{scan_id}.txt",
+                    )
+                )
+            out = pnp_localize_pair(
+                matches[0, idx],
+                (qh, qw),
+                cutout.shape[:2],
+                cutout,
+                args.focal,
+                alignment=align,
+                score_thr=args.score_thr,
+                pnp_thr_deg=args.pnp_thr_deg,
+            )
+            entry["topNname"].append(pano_fn)
+            entry["P"].append(
+                None if out["P"] is None else out["P"].tolist()
+            )
+        results.append(entry)
+        print(f"query {q + 1}: {sum(p_ is not None for p_ in entry['P'])} "
+              f"poses", flush=True)
+
+    with open(args.out, "w") as f:
+        json.dump(results, f)
+    print(f"wrote {args.out}")
+
+    if args.refposes:
+        gt = loadmat(args.refposes, squeeze_me=True)
+        pos_err, ori_err = [], []
+        for list_name, floor in (("DUC1_RefList", "DUC1"),
+                                 ("DUC2_RefList", "DUC2")):
+            for rec in np.atleast_1d(gt[list_name]):
+                qname = str(rec["queryname"])
+                match = next(
+                    (r for r in results if r["queryname"] == qname), None
+                )
+                ok = (
+                    match is not None
+                    and match["P"]
+                    and match["P"][0] is not None
+                    and match["topNname"][0].split("/")[0] == floor
+                )
+                if ok:
+                    dp, do = pose_distance(
+                        np.asarray(rec["P"]), np.asarray(match["P"][0])
+                    )
+                else:
+                    dp, do = np.inf, np.inf
+                pos_err.append(dp)
+                ori_err.append(do)
+        thr, rate = localization_rate_curve(pos_err, ori_err)
+        for t, r in zip(thr, rate):
+            print(f"  {t:6.4f} m : {r:5.1f} %")
+
+
+if __name__ == "__main__":
+    main()

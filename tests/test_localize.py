@@ -1,0 +1,161 @@
+"""Synthetic-geometry tests for the PnP localization stage (the Python
+port of lib_matlab/parfor_NC4D_PE_pnponly.m + p2dist.m +
+ht_plotcurve_WUSTL.m)."""
+
+import numpy as np
+import pytest
+
+from ncnet_tpu.eval.localize import (
+    camera_center,
+    dlt_pnp,
+    lo_ransac_p3p,
+    localization_rate_curve,
+    p3p_grunert,
+    pnp_localize_pair,
+    pose_distance,
+)
+
+
+def _random_pose(rng):
+    A = rng.randn(3, 3)
+    Q, _ = np.linalg.qr(A)
+    if np.linalg.det(Q) < 0:
+        Q[:, 0] *= -1
+    t = rng.randn(3) * 0.5 + np.array([0, 0, 4.0])
+    return np.concatenate([Q, t[:, None]], axis=1)
+
+
+def _project_rays(P, X):
+    Xc = X @ P[:, :3].T + P[:, 3]
+    return Xc / np.linalg.norm(Xc, axis=1, keepdims=True)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_p3p_recovers_ground_truth(seed):
+    rng = np.random.RandomState(seed)
+    P_gt = _random_pose(rng)
+    X = rng.randn(3, 3) * 2.0
+    rays = _project_rays(P_gt, X)
+    sols = p3p_grunert(rays, X)
+    assert sols, "no P3P solutions"
+    errs = [pose_distance(P_gt, P)[0] + pose_distance(P_gt, P)[1] for P in sols]
+    assert min(errs) < 1e-6
+
+
+def test_dlt_pnp_recovers_ground_truth():
+    """Many trials: the SVD null vector's sign is random, so a sign-handling
+    bug passes a handful of lucky seeds but fails ~half of a sweep."""
+    failures = 0
+    for seed in range(50):
+        rng = np.random.RandomState(seed + 10)
+        P_gt = _random_pose(rng)
+        X = rng.randn(12, 3) * 2.0
+        rays = _project_rays(P_gt, X)
+        P = dlt_pnp(rays, X)
+        if P is None:
+            failures += 1
+            continue
+        dp, do = pose_distance(P_gt, P)
+        if dp > 1e-6 or do > 1e-6:
+            failures += 1
+    assert failures == 0
+
+
+def test_lo_ransac_rejects_outliers():
+    rng = np.random.RandomState(42)
+    P_gt = _random_pose(rng)
+    n_in, n_out = 40, 40
+    X = rng.randn(n_in + n_out, 3) * 2.0
+    rays = _project_rays(P_gt, X)
+    # corrupt the second half with random directions
+    bad = rng.randn(n_out, 3)
+    rays[n_in:] = bad / np.linalg.norm(bad, axis=1, keepdims=True)
+    P, inl = lo_ransac_p3p(rays, X, np.deg2rad(0.2), max_iters=2000, seed=1)
+    assert P is not None
+    dp, do = pose_distance(P_gt, P)
+    assert dp < 1e-3 and do < 1e-3
+    assert inl[:n_in].sum() >= n_in - 1  # finds (nearly) all true inliers
+    assert inl[n_in:].sum() <= 2  # and (nearly) no false ones
+
+
+def test_pose_distance_identities():
+    rng = np.random.RandomState(0)
+    P = _random_pose(rng)
+    dp, do = pose_distance(P, P)
+    assert dp == 0.0 and do == 0.0
+    # translate the camera center by 1m: position error 1, orientation 0
+    P2 = P.copy()
+    C = camera_center(P)
+    P2[:, 3] = -P[:, :3] @ (C + np.array([1.0, 0, 0]))
+    dp, do = pose_distance(P, P2)
+    np.testing.assert_allclose(dp, 1.0, rtol=1e-6)
+    assert do < 1e-6
+
+
+def test_localization_rate_curve_reference_grid():
+    pos = np.array([0.05, 0.5, 1.5, np.inf])
+    ori = np.deg2rad(np.array([1.0, 1.0, 1.0, 1.0]))
+    thr, rate = localization_rate_curve(pos, ori)
+    assert thr[0] == 0.0 and thr[-1] == 2.0
+    assert len(thr) == 17 + 8  # 0:0.0625:1 (17) + 1.125:0.125:2 (8)
+    # at 2m: 3 of 4 localized
+    np.testing.assert_allclose(rate[-1], 75.0)
+    # orientation gate: >10 deg kills an otherwise-perfect pose
+    _, rate_gated = localization_rate_curve(
+        np.array([0.01]), np.deg2rad([20.0])
+    )
+    assert rate_gated[-1] == 0.0
+
+
+def test_pnp_localize_pair_end_to_end():
+    """Full parfor_NC4D_PE_pnponly math on a synthetic RGBD cutout."""
+    rng = np.random.RandomState(7)
+    dh, dw = 60, 80
+    qh, qw = 48, 64
+    fl = 50.0
+
+    # a smooth 3D surface seen by the DB cutout, in "scan-local" coords
+    gy, gx = np.mgrid[0:dh, 0:dw]
+    xyz_local = np.stack(
+        [gx * 0.05, gy * 0.05, 3.0 + 0.3 * np.sin(gx * 0.1)], axis=-1
+    )
+    xyz_local[5:8, 5:8] = np.nan  # invalid depth region
+    # scan-to-global alignment
+    A = _random_pose(rng)
+
+    P_gt = _random_pose(rng)  # query camera, global frame
+
+    # build matches: sample DB pixels, project their GLOBAL 3D into the
+    # query camera to get the query-side normalized coords
+    n = 120
+    px = rng.randint(1, dw + 1, n)  # MATLAB 1-indexed pixels
+    py = rng.randint(1, dh + 1, n)
+    X_local = xyz_local[py - 1, px - 1]
+    X_glob = X_local @ A[:3, :3].T + A[:3, 3]
+    Xc = X_glob @ P_gt[:, :3].T + P_gt[:, 3]
+    xq = Xc[:, 0] / Xc[:, 2] * fl + qw / 2.0
+    yq = Xc[:, 1] / Xc[:, 2] * fl + qh / 2.0
+
+    matches = np.stack(
+        [
+            xq / qw,
+            yq / qh,
+            # inverse of floor(x * dw) = px: any value in [px/dw, (px+1)/dw)
+            (px + 0.5) / dw,
+            (py + 0.5) / dh,
+            np.full(n, 0.9),
+        ],
+        axis=1,
+    )
+    # low-score rows must be dropped by the 0.75 threshold
+    matches[::10, 4] = 0.1
+
+    out = pnp_localize_pair(
+        matches, (qh, qw), (dh, dw), xyz_local, fl, alignment=A,
+        max_iters=2000, seed=3,
+    )
+    assert out["P"] is not None
+    dp, do = pose_distance(P_gt, out["P"])
+    assert dp < 1e-2 and do < 1e-2
+    # the NaN-depth tentatives were dropped
+    assert out["tentatives_3d"].shape[1] <= n
