@@ -162,13 +162,14 @@ def dlt_pnp(rays, points):
     return np.concatenate([R, t[:, None]], axis=1)
 
 
-def _angular_inliers(P, rays, points, cos_thr):
-    f = rays / np.linalg.norm(rays, axis=1, keepdims=True)
+def _angular_inliers(P, unit_rays, points, cos_thr):
+    """``unit_rays`` must be pre-normalized (hot loop: called per RANSAC
+    hypothesis; normalize once in the caller)."""
     Xc = (P[:, :3] @ points.T + P[:, 3:4]).T
     norms = np.linalg.norm(Xc, axis=1)
     ok = norms > 1e-12
     cosang = np.zeros(len(points))
-    cosang[ok] = np.sum(f[ok] * Xc[ok], axis=1) / norms[ok]
+    cosang[ok] = np.sum(unit_rays[ok] * Xc[ok], axis=1) / norms[ok]
     return cosang > cos_thr
 
 
@@ -193,6 +194,7 @@ def lo_ransac_p3p(rays, points, thr_rad, max_iters=10000, seed=0,
         return None, empty
     rng = np.random.RandomState(seed)
     cos_thr = np.cos(thr_rad)
+    rays = rays / np.linalg.norm(rays, axis=1, keepdims=True)
     best_P, best_inl = None, empty
     it, needed = 0, max_iters
     while it < min(max_iters, needed):

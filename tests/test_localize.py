@@ -130,6 +130,8 @@ def test_pnp_localize_pair_end_to_end():
     n = 120
     px = rng.randint(1, dw + 1, n)  # MATLAB 1-indexed pixels
     py = rng.randint(1, dh + 1, n)
+    # force a few samples into the NaN-depth region (1-indexed 6..8)
+    px[1:4] = py[1:4] = 7
     X_local = xyz_local[py - 1, px - 1]
     X_glob = X_local @ A[:3, :3].T + A[:3, 3]
     Xc = X_glob @ P_gt[:, :3].T + P_gt[:, 3]
@@ -157,5 +159,10 @@ def test_pnp_localize_pair_end_to_end():
     assert out["P"] is not None
     dp, do = pose_distance(P_gt, out["P"])
     assert dp < 1e-2 and do < 1e-2
-    # the NaN-depth tentatives were dropped
-    assert out["tentatives_3d"].shape[1] <= n
+    # exact tentative count: score-filtered rows minus NaN-depth hits
+    kept = np.ones(n, bool)
+    kept[::10] = False  # score threshold
+    nan_hit = ~np.isfinite(X_local[kept]).all(axis=1)
+    expected = kept.sum() - nan_hit.sum()
+    assert nan_hit.sum() > 0, "fixture must sample the NaN-depth region"
+    assert out["tentatives_3d"].shape[1] == expected
