@@ -35,11 +35,16 @@ def collate(samples):
 
 
 def shard_indices(n, host_id, n_hosts):
-    """Contiguous per-host shard of dataset indices."""
+    """Contiguous per-host shard of dataset indices.
+
+    Shards are EQUAL-SIZED (the remainder ``n % n_hosts`` is dropped):
+    unequal shards give hosts different batch counts, and in multi-host
+    training the host with the extra batch blocks forever in its step's
+    collective while the others have finished the epoch.
+    """
     per = n // n_hosts
     start = host_id * per
-    end = start + per if host_id < n_hosts - 1 else n
-    return np.arange(start, end)
+    return np.arange(start, start + per)
 
 
 class DataLoader:

@@ -23,9 +23,89 @@ def make_mesh(mesh_shape=None, axis_names=("data",), devices=None):
     return Mesh(dev_array, axis_names)
 
 
+def initialize_multihost(coordinator_address=None, num_processes=None,
+                         process_id=None):
+    """Join a multi-host JAX runtime (the NCCL/MPI-backend analog).
+
+    MUST be called before anything touches the XLA backend (including
+    ``jax.devices()``/``jax.process_count()`` — they initialize it). On TPU
+    pods the arguments are auto-detected from the environment; elsewhere
+    pass them explicitly. After this, ``jax.devices()`` spans all hosts and
+    XLA routes collectives over ICI within a slice / DCN across slices.
+
+    With explicit arguments, initialization failures raise. With
+    auto-detection, the expected no-cluster case falls back to single-host
+    WITH a visible log line (a silent fallback on a real pod would leave
+    every host training its own divergent model).
+
+    Returns ``(process_index, process_count)`` for per-host data feeding
+    (`data.loader.DataLoader(host_id=..., n_hosts=...)`).
+    """
+    explicit = coordinator_address is not None or num_processes is not None
+    try:
+        if explicit:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        else:
+            jax.distributed.initialize()  # env/TPU-pod auto-detection
+    except Exception as e:  # noqa: BLE001 — explicit path re-raises
+        if explicit:
+            raise
+        print(
+            "initialize_multihost: single-host fallback "
+            f"({type(e).__name__}: {e})",
+            flush=True,
+        )
+    return jax.process_index(), jax.process_count()
+
+
+def make_hybrid_mesh(per_host_shape=None, axis_names=("data",)):
+    """Mesh spanning all hosts with DCN-aware device placement.
+
+    Uses `mesh_utils.create_hybrid_device_mesh` so the leading mesh dim
+    maps across hosts (DCN) and the trailing dims stay within a host's ICI
+    domain — collectives along the trailing axes never cross DCN. With one
+    process this reduces to `make_mesh`.
+
+    Args:
+      per_host_shape: shape of the within-host part of the mesh (default:
+        all local devices on one axis).
+    """
+    n_proc = jax.process_count()
+    if n_proc == 1:
+        return make_mesh(per_host_shape, axis_names)
+    from jax.experimental import mesh_utils
+
+    local = jax.local_device_count()
+    if per_host_shape is None:
+        per_host_shape = (local,)
+    dev = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=per_host_shape,
+        dcn_mesh_shape=(n_proc,) + (1,) * (len(per_host_shape) - 1),
+    )  # shape: (n_proc * per_host_shape[0], *per_host_shape[1:])
+    return Mesh(dev, axis_names)
+
+
 def shard_batch(mesh, batch, axis="data"):
-    """Put a batch dict on device, sharded along the leading (batch) dim."""
+    """Put a batch dict on device, sharded along the leading (batch) dim.
+
+    Single-process: a plain sharded device_put. Multi-host: each process
+    passes its HOST-LOCAL slice of the global batch (global batch size =
+    local size x process_count along ``axis``) and the global array is
+    assembled with `jax.make_array_from_process_local_data` — no host ever
+    materializes the full batch.
+    """
     sharding = NamedSharding(mesh, P(axis))
+    if jax.process_count() > 1:
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)
+            ),
+            batch,
+        )
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 

@@ -15,7 +15,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ncnet_tpu.parallel.mesh import make_mesh, replicate, shard_batch
+from ncnet_tpu.parallel.mesh import make_hybrid_mesh, replicate, shard_batch
 from ncnet_tpu.train.checkpoint import CheckpointData, save_checkpoint
 from ncnet_tpu.train.step import (
     create_train_state,
@@ -26,13 +26,15 @@ from ncnet_tpu.train.step import (
 
 
 def _device_batch(mesh, batch):
-    jb = {
-        "source_image": jnp.asarray(batch["source_image"]),
-        "target_image": jnp.asarray(batch["target_image"]),
+    sub = {
+        "source_image": batch["source_image"],
+        "target_image": batch["target_image"],
     }
     if mesh is not None:
-        jb = shard_batch(mesh, jb)
-    return jb
+        # host-local numpy goes straight to shard_batch (multi-host
+        # assembles the global array from per-process slices)
+        return shard_batch(mesh, sub)
+    return {k: jnp.asarray(v) for k, v in sub.items()}
 
 
 def train(
@@ -56,7 +58,9 @@ def train(
     profile_dir=None,
     profile_steps=(3, 8),
 ):
-    mesh = make_mesh() if data_parallel and len(jax.devices()) > 1 else None
+    # hybrid mesh: leading axis maps across hosts (DCN), trailing within a
+    # host's ICI domain; reduces to a plain all-device mesh single-process
+    mesh = make_hybrid_mesh() if data_parallel and jax.device_count() > 1 else None
     if mesh is not None:
         params = replicate(mesh, params)
 

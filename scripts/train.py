@@ -55,10 +55,26 @@ def main():
     p.add_argument("--profile_dir", type=str, default="",
                    help="capture a jax.profiler trace of a few early steps "
                         "into this directory")
+    p.add_argument("--multihost", action="store_true",
+                   help="join a multi-host JAX runtime (TPU pod slices: "
+                        "auto-detected); shards the data loaders per host")
     p.add_argument("--conv4d_impl", type=str, default="cfs",
                    choices=["xla", "taps", "scan", "tlc", "tf3", "tf2",
                             "cf", "cfs", "gemm", "gemms", "pallas"])
     args = p.parse_args()
+
+    host_id, n_hosts = 0, 1
+    if args.multihost:
+        from ncnet_tpu.parallel.mesh import initialize_multihost
+
+        host_id, n_hosts = initialize_multihost()
+        print(f"multihost: process {host_id}/{n_hosts}, "
+              f"{jax.device_count()} global devices")
+        if args.batch_size % n_hosts:
+            p.error(
+                f"--batch_size {args.batch_size} (global) must divide the "
+                f"{n_hosts} hosts"
+            )
 
     if (
         not args.fe_weights
@@ -147,13 +163,18 @@ def main():
             os.path.join(args.dataset_csv_path, "val_pairs.csv"),
             args.dataset_image_path, output_size=size, seed=args.seed,
         )
+    # --batch_size is GLOBAL; each host loads its 1/n_hosts slice and the
+    # global array is assembled in shard_batch (parallel/mesh.py)
+    local_bs = args.batch_size // n_hosts
     train_loader = DataLoader(
-        train_ds, args.batch_size, shuffle=True, seed=args.seed,
+        train_ds, local_bs, shuffle=True, seed=args.seed,
         num_workers=args.num_workers, drop_last=True,
+        host_id=host_id, n_hosts=n_hosts,
     )
     val_loader = DataLoader(
-        val_ds, args.batch_size, shuffle=False,
+        val_ds, local_bs, shuffle=False,
         num_workers=args.num_workers, drop_last=True,
+        host_id=host_id, n_hosts=n_hosts,
     )
 
     train(

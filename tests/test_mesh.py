@@ -1,0 +1,38 @@
+"""Mesh helpers: shapes, hybrid single-process reduction, multihost no-op."""
+
+import numpy as np
+
+import jax
+
+from ncnet_tpu.parallel.mesh import (
+    initialize_multihost,
+    make_hybrid_mesh,
+    make_mesh,
+    replicate,
+    shard_batch,
+)
+
+
+def test_make_mesh_2d():
+    mesh = make_mesh((2, 4), ("data", "spatial"))
+    assert mesh.shape == {"data": 2, "spatial": 4}
+
+
+def test_hybrid_mesh_single_process_reduces_to_make_mesh():
+    mesh = make_hybrid_mesh()
+    assert mesh.shape == {"data": len(jax.devices())}
+
+
+def test_initialize_multihost_single_process_noop():
+    pid, n = initialize_multihost()
+    assert (pid, n) == (0, 1)
+
+
+def test_shard_and_replicate_roundtrip():
+    mesh = make_mesh()
+    batch = {"x": np.arange(16, dtype=np.float32).reshape(8, 2)}
+    sharded = shard_batch(mesh, batch)
+    np.testing.assert_array_equal(np.asarray(sharded["x"]), batch["x"])
+    params = {"w": np.ones((3,), np.float32)}
+    rep = replicate(mesh, params)
+    np.testing.assert_array_equal(np.asarray(rep["w"]), params["w"])
