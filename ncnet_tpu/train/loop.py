@@ -144,6 +144,8 @@ def train(
             + (" [best]" if is_best else ""),
             flush=True,
         )
+        if jax.process_index() != 0:
+            continue  # multi-host: only process 0 writes checkpoints
         save_checkpoint(
             os.path.join(checkpoint_dir, checkpoint_name),
             CheckpointData(

@@ -1,10 +1,13 @@
-"""InLoc PnP localization CLI — the MATLAB stage as one Python command.
+"""InLoc localization CLI — the MATLAB stages as one Python command.
 
-Equivalent to compute_densePE_NCNet.m -> ir_top100_NC4D_localization_pnponly.m
-(PnP-only path): for every query in the shortlist, load the matches dumped
-by scripts/eval_inloc.py, estimate a pose per top-N pano with P3P
-LO-RANSAC (ncnet_tpu.eval.localize), and — when ground-truth poses are
-provided — print the localization-rate curve
+Equivalent to compute_densePE_NCNet.m: for every query in the shortlist,
+load the matches dumped by scripts/eval_inloc.py, estimate a pose per
+top-N pano with P3P LO-RANSAC (ncnet_tpu.eval.localize — the
+ir_top100_NC4D_localization_pnponly.m stage), optionally re-rank the
+candidates by dense pose verification (--densePV, the
+ht_top10_NC4D_PV_localization.m stage: render the scan point cloud at
+each candidate pose, dense-descriptor similarity), and — when
+ground-truth poses are provided — print the localization-rate curve
 (ht_plotcurve_WUSTL.m semantics: position threshold sweep 0..2 m,
 orientation gated at 10 deg).
 
@@ -83,6 +86,14 @@ def main():
     p.add_argument("--refposes", default="",
                    help=".mat with DUC1_RefList/DUC2_RefList GT poses; "
                         "prints the localization curve when given")
+    p.add_argument("--densePV", action="store_true",
+                   help="re-rank pose candidates by dense pose "
+                        "verification (render the scan at each candidate "
+                        "pose, dense-descriptor similarity); needs "
+                        "--scan_dir")
+    p.add_argument("--scan_dir", default="",
+                   help="dir of '<scene>_scan_<scan>.mat' point clouds "
+                        "(cell array A: columns X Y Z _ R G B)")
     p.add_argument("--out", default="localization.json")
     args = p.parse_args()
 
@@ -136,6 +147,69 @@ def main():
         results.append(entry)
         print(f"query {q + 1}: {sum(p_ is not None for p_ in entry['P'])} "
               f"poses", flush=True)
+
+    if args.densePV:
+        if not args.scan_dir:
+            p.error("--densePV requires --scan_dir")
+        from ncnet_tpu.eval.pose_verify import (
+            prepare_query,
+            rerank_by_pose_verification,
+            score_prepared,
+        )
+
+        @functools.lru_cache(maxsize=4)
+        def load_scan(floor, scene_id, scan_id):
+            """Colored scan point cloud, GLOBAL coords (at_pv_wrapper.m:
+            A{1..3}=XYZ, A{5..7}=RGB, homogeneous P_after transform).
+            Cached per SCAN — many cutouts (yaw/pitch views) share one."""
+            from scipy.io import loadmat
+
+            cells = loadmat(
+                os.path.join(
+                    args.scan_dir, floor,
+                    f"{scene_id}_scan_{scan_id}.mat",
+                )
+            )["A"].ravel()
+            xyz = np.concatenate([cells[0], cells[1], cells[2]], axis=1)
+            rgb = np.concatenate([cells[4], cells[5], cells[6]], axis=1)
+            if args.transform_dir:
+                P_after = load_alignment(
+                    os.path.join(
+                        args.transform_dir, floor, "transformations",
+                        f"{scene_id}_trans_{scan_id}.txt",
+                    )
+                )
+                h = xyz @ P_after[:3, :3].T + P_after[:3, 3]
+                w4 = xyz @ P_after[3, :3] + P_after[3, 3]
+                xyz = h / w4[:, None]
+            return rgb, xyz
+
+        prep_cache = {}
+
+        def score_candidate(entry, j):
+            P = entry["P"][j]
+            if P is None:
+                return 0.0
+            if entry["queryname"] not in prep_cache:
+                with Image.open(
+                    os.path.join(args.query_dir, entry["queryname"])
+                ) as im:
+                    img = np.asarray(im)
+                prep_cache.clear()  # one query's prep live at a time
+                prep_cache[entry["queryname"]] = prepare_query(
+                    img, args.focal
+                )
+            pano_fn = entry["topNname"][j]
+            parts = os.path.basename(pano_fn).split("_")
+            rgb, xyz = load_scan(pano_fn.split("/")[0], parts[0], parts[2])
+            return score_prepared(
+                prep_cache[entry["queryname"]], rgb, xyz, np.asarray(P)
+            )
+
+        results = rerank_by_pose_verification(
+            results, score_candidate, top_n=args.n_panos
+        )
+        print("densePV re-ranking done")
 
     with open(args.out, "w") as f:
         json.dump(results, f)

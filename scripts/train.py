@@ -70,10 +70,12 @@ def main():
         host_id, n_hosts = initialize_multihost()
         print(f"multihost: process {host_id}/{n_hosts}, "
               f"{jax.device_count()} global devices")
-        if args.batch_size % n_hosts:
+        n_dev = jax.device_count()
+        if args.batch_size % n_dev:
             p.error(
-                f"--batch_size {args.batch_size} (global) must divide the "
-                f"{n_hosts} hosts"
+                f"--batch_size {args.batch_size} (global) must be "
+                f"divisible by the {n_dev} global devices (the data-"
+                f"parallel shard axis), hence also the {n_hosts} hosts"
             )
 
     if (

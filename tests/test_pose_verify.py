@@ -1,0 +1,119 @@
+"""Dense pose verification on synthetic scenes: rendering, descriptors,
+and the discriminative property (correct pose out-scores wrong ones)."""
+
+import numpy as np
+import pytest
+
+from ncnet_tpu.eval.pose_verify import (
+    dense_root_sift,
+    image_normalization,
+    inpaint_nearest,
+    pose_verification_score,
+    project_points_persp,
+    rerank_by_pose_verification,
+)
+
+
+def _scene(rng, n=40000):
+    """A textured plane at z=0 viewed from above: colorful checkerboard."""
+    xy = rng.rand(n, 2) * 8.0 - 4.0
+    xyz = np.concatenate([xy, np.zeros((n, 1))], axis=1)
+    checker = ((np.floor(xy[:, 0] * 2) + np.floor(xy[:, 1] * 2)) % 2)
+    stripes = (np.floor(xy[:, 0] * 4) % 2)
+    rgb = np.stack(
+        [checker * 255, stripes * 255, (checker + stripes) % 2 * 255], axis=1
+    )
+    return rgb, xyz
+
+
+def _pose(tz=6.0, tx=0.0, angle=0.0):
+    """Proper rotation: camera at (tx, 0, tz) looking straight down at the
+    plane (z_cam = tz - z_world > 0), optionally yawed by ``angle``."""
+    c, s = np.cos(angle), np.sin(angle)
+    Rz = np.array([[c, -s, 0.0], [s, c, 0.0], [0.0, 0.0, 1.0]])
+    R = np.diag([1.0, -1.0, -1.0]) @ Rz  # det +1
+    C = np.array([tx, 0.0, tz])
+    return np.concatenate([R, (-R @ C)[:, None]], axis=1)
+
+
+def _render_query(rgb, xyz, P, fl, h, w):
+    K = np.array([[fl, 0, w / 2.0], [0, fl, h / 2.0], [0, 0, 1.0]])
+    img, _, valid = project_points_persp(rgb, xyz, K @ P, h, w)
+    return inpaint_nearest(img, valid), valid
+
+
+def test_projection_zbuffer_and_bounds():
+    rgb = np.array([[255.0, 0, 0], [0, 255.0, 0]])
+    # two points on the same ray; the nearer (z=1) must win
+    xyz = np.array([[0.0, 0.0, 1.0], [0.0, 0.0, 2.0]])
+    KP = np.array([[10.0, 0, 5, 0], [0, 10.0, 5, 0], [0, 0, 1.0, 0]])
+    img, xyzp, valid = project_points_persp(rgb, xyz, KP, 10, 10)
+    assert valid[5, 5]
+    np.testing.assert_array_equal(img[5, 5], [255.0, 0, 0])
+    np.testing.assert_allclose(xyzp[5, 5], [0, 0, 1.0])
+    assert valid.sum() == 1
+
+
+def test_inpaint_and_normalization():
+    img = np.arange(16, dtype=np.float64).reshape(4, 4)
+    valid = np.ones((4, 4), bool)
+    valid[0, 0] = False
+    filled = inpaint_nearest(img, valid)
+    assert filled[0, 0] in (img[0, 1], img[1, 0], img[1, 1])
+    norm = image_normalization(img, valid)
+    vals = norm[valid]
+    np.testing.assert_allclose(vals.mean(), 0.0, atol=1e-12)
+    np.testing.assert_allclose(vals.std(), 1.0, atol=1e-9)
+
+
+def test_dense_root_sift_shape_and_norm():
+    rng = np.random.RandomState(0)
+    img = rng.rand(64, 80)
+    centers, desc = dense_root_sift(img)
+    assert desc.shape[1] == 128
+    assert len(centers) == len(desc) > 0
+    # RootSIFT: squared descriptors are L1-normalized
+    np.testing.assert_allclose((desc**2).sum(axis=1), 1.0, atol=1e-6)
+    # centers lie inside the image
+    assert centers[:, 0].max() < 80 and centers[:, 1].max() < 64
+
+
+def test_correct_pose_outscores_wrong_poses():
+    """The discriminative property the PV stage exists for
+    (parfor_nc4d_PV.m): rendering at the true pose matches the query far
+    better than rendering at perturbed poses."""
+    rng = np.random.RandomState(1)
+    rgb, xyz = _scene(rng)
+    fl = 150.0
+    h, w = 120, 160
+    P_true = _pose(tz=6.0)
+    query, _ = _render_query(rgb, xyz, P_true, fl, h, w)
+    # score at native scale (downsample=1, smaller descriptor support —
+    # the 8x stage default assumes multi-megapixel InLoc queries)
+    kw = dict(downsample=1.0, bin_size=4, step=4)
+    score_true = pose_verification_score(query, rgb, xyz, P_true, fl, **kw)
+    score_shift = pose_verification_score(
+        query, rgb, xyz, _pose(tz=6.0, tx=1.5), fl, **kw
+    )
+    score_rot = pose_verification_score(
+        query, rgb, xyz, _pose(tz=6.0, angle=0.6), fl, **kw
+    )
+    score_nan = pose_verification_score(
+        query, rgb, xyz, np.full((3, 4), np.nan), fl, **kw
+    )
+    assert score_nan == 0.0
+    assert score_true > score_shift
+    assert score_true > score_rot
+
+
+def test_rerank_orders_by_score():
+    entries = [
+        {"queryname": "q", "topNname": ["a", "b", "c"],
+         "P": [np.eye(3, 4), np.eye(3, 4), np.eye(3, 4)]}
+    ]
+    scores = {0: 0.1, 1: 0.9, 2: 0.5}
+    out = rerank_by_pose_verification(
+        entries, lambda e, j: scores[j], top_n=3
+    )
+    assert out[0]["topNname"] == ["b", "c", "a"]
+    assert out[0]["topNscore"] == [0.9, 0.5, 0.1]
