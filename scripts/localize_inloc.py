@@ -40,6 +40,14 @@ def load_cutout(path):
     return loadmat(path)["XYZcut"]
 
 
+def parse_cutout_name(pano_fn):
+    """'<floor>/<scene>_cutout_<scan>_<yaw>_<pitch>.jpg' ->
+    (floor, scene_id, scan_id) — the parse_WUSTL_cutoutname role."""
+    floor = pano_fn.split("/")[0]
+    parts = os.path.basename(pano_fn).split("_")
+    return floor, parts[0], parts[2]
+
+
 @functools.lru_cache(maxsize=256)
 def load_alignment(path):
     """Last 4 numeric rows of the transformation txt -> [4, 4] P_after."""
@@ -92,10 +100,13 @@ def main():
                         "pose, dense-descriptor similarity); needs "
                         "--scan_dir")
     p.add_argument("--scan_dir", default="",
-                   help="dir of '<scene>_scan_<scan>.mat' point clouds "
+                   help="scan point-cloud root: "
+                        "<scan_dir>/<floor>/<scene>_scan_<scan>.mat "
                         "(cell array A: columns X Y Z _ R G B)")
     p.add_argument("--out", default="localization.json")
     args = p.parse_args()
+    if args.densePV and not args.scan_dir:
+        p.error("--densePV requires --scan_dir")
 
     from PIL import Image
 
@@ -118,12 +129,7 @@ def main():
             )
             align = None
             if args.transform_dir:
-                floor = pano_fn.split("/")[0]
-                base = os.path.basename(pano_fn)
-                # cutout names are '<scene>_cutout_<scan>_<yaw>_<pitch>.jpg'
-                # (parse_WUSTL_cutoutname): scene token 0, scan token 2
-                parts = base.split("_")
-                scene_id, scan_id = parts[0], parts[2]
+                floor, scene_id, scan_id = parse_cutout_name(pano_fn)
                 align = load_alignment(
                     os.path.join(
                         args.transform_dir, floor, "transformations",
@@ -149,8 +155,6 @@ def main():
               f"poses", flush=True)
 
     if args.densePV:
-        if not args.scan_dir:
-            p.error("--densePV requires --scan_dir")
         from ncnet_tpu.eval.pose_verify import (
             prepare_query,
             rerank_by_pose_verification,
@@ -179,9 +183,9 @@ def main():
                         f"{scene_id}_trans_{scan_id}.txt",
                     )
                 )
-                h = xyz @ P_after[:3, :3].T + P_after[:3, 3]
-                w4 = xyz @ P_after[3, :3] + P_after[3, 3]
-                xyz = h / w4[:, None]
+                # affine application, IDENTICAL to pnp_localize_pair's —
+                # the PV render and the PnP pose must share one frame
+                xyz = xyz @ P_after[:3, :3].T + P_after[:3, 3]
             return rgb, xyz
 
         prep_cache = {}
@@ -199,9 +203,7 @@ def main():
                 prep_cache[entry["queryname"]] = prepare_query(
                     img, args.focal
                 )
-            pano_fn = entry["topNname"][j]
-            parts = os.path.basename(pano_fn).split("_")
-            rgb, xyz = load_scan(pano_fn.split("/")[0], parts[0], parts[2])
+            rgb, xyz = load_scan(*parse_cutout_name(entry["topNname"][j]))
             return score_prepared(
                 prep_cache[entry["queryname"]], rgb, xyz, np.asarray(P)
             )

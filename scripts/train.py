@@ -58,7 +58,7 @@ def main():
     p.add_argument("--multihost", action="store_true",
                    help="join a multi-host JAX runtime (TPU pod slices: "
                         "auto-detected); shards the data loaders per host")
-    p.add_argument("--conv4d_impl", type=str, default="cfs",
+    p.add_argument("--conv4d_impl", type=str, default="tlc",
                    choices=["xla", "taps", "scan", "tlc", "tf3", "tf2",
                             "cf", "cfs", "gemm", "gemms", "pallas"])
     args = p.parse_args()
