@@ -101,6 +101,8 @@ def main():
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--alpha", type=float, default=0.15)
     p.add_argument("--conv4d_impl", type=str, default="cfs")
+    p.add_argument("--ncons_kernel_sizes", nargs="+", type=int, default=[3, 3])
+    p.add_argument("--ncons_channels", nargs="+", type=int, default=[16, 1])
     args = p.parse_args()
     out = run(
         image_size=args.image_size,
@@ -110,6 +112,8 @@ def main():
         seed=args.seed,
         alpha=args.alpha,
         conv4d_impl=args.conv4d_impl,
+        ncons_kernel_sizes=tuple(args.ncons_kernel_sizes),
+        ncons_channels=tuple(args.ncons_channels),
     )
     ok = out["loss_last"] < out["loss_first"] and out["pck_after"] > out["pck_before"]
     print(f"convergence {'OK' if ok else 'NOT DEMONSTRATED'}")

@@ -65,6 +65,33 @@ def test_sharded_pipeline_with_relocalization():
         np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
 
 
+def test_sharded_pipeline_high_res_rect_grid_8_shards():
+    """BASELINE config-5 shaped: a large rectangular grid (the InLoc
+    aspect-preserving resize regime) sharded over all 8 devices, with
+    relocalization — the configuration whose corr4d exceeds single-chip
+    HBM at full scale."""
+    cfg = ImMatchNetConfig(
+        ncons_kernel_sizes=(3, 3), ncons_channels=(4, 1),
+        relocalization_k_size=2,
+    )
+    mesh = make_mesh((8,), ("spatial",), devices=jax.devices()[:8])
+    params = init_immatchnet(jax.random.PRNGKey(4), cfg)
+    rng = np.random.RandomState(4)
+    # A rows 32: divides 8 shards x k=2; rectangular B grid 32x24
+    fa = jnp.asarray(rng.randn(1, 32, 24, 16).astype(np.float32))
+    fb = jnp.asarray(rng.randn(1, 32, 24, 16).astype(np.float32))
+
+    want_corr, want_d = match_pipeline(params["neigh_consensus"], cfg, fa, fb)
+    got_corr, got_d = make_sharded_match_pipeline(cfg, mesh)(
+        params["neigh_consensus"], fa, fb
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_corr), np.asarray(want_corr), rtol=1e-4, atol=1e-5
+    )
+    for g, w in zip(got_d, want_d):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
 def test_inloc_match_fn_sharded_agrees_with_unsharded():
     """End-to-end InLoc surface (BASELINE config-5 shaped): make_match_fn
     with a spatial mesh produces the same match lists as single-device."""
