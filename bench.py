@@ -21,7 +21,9 @@ channel-fused conv2d 'cf'/'cfs', im2col GEMM, Toeplitz 'tlc'); only
 Best known config (11.9 pairs/s, 10.4% MFU): tlc + loss_chunk 8 + chunk
 remat with the 'nc_conv' save-policy (convs not recomputed in backward) —
 tlc's 5x-inflated wide-lane forward wins end-to-end once the policy stops
-the backward from re-running forwards; cfs + chunk 4 = 10.5.
+the backward from re-running forwards; cfs + chunk 4 = 10.5. The blocked
+Toeplitz 'btl' (3.1x inflation, 192/128 lanes) measures 11.0 at chunk 4 —
+the per-block window gather costs what the FLOP reduction saves.
 
 Baseline: the reference repo publishes no throughput numbers (BASELINE.md).
 ``V100_EST_PAIRS_PER_SEC`` is an analytic estimate for the reference

@@ -30,33 +30,37 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _toeplitz_l_weights(w, l_size):
-    """Expand ``w`` into a banded (Toeplitz) channel-mixing matrix over l.
+def _banded_weights(w, n_rows, n_cols, offset):
+    """Expand ``w`` into a banded (Toeplitz) channel-mixing matrix over l:
+    ``[ki,kj,kk,kl,cin,cout] -> [ki,kj,kk, n_rows*cin, n_cols*cout]`` with
+    ``T[r, c, col, o] = w[..., r - col + offset, c, o]`` (zero off-band).
 
-    The 4D convolution's reduction over the last spatial dim (l) and input
-    channels is re-expressed as a DENSE channel contraction ``(l', c) ->
-    (l, o)`` whose matrix is zero off the +-kl//2 band:
-
-      T[di, dj, dk, (l', c), (l, o)] = w[di, dj, dk, l'-l+pad, c, o]
-
-    This inflates FLOPs by ``l_size / kl`` (5x at the training grid 25) but
-    gives the MXU full 128-lane tiles (l*c = l*o = 400 at the PF-Pascal
-    config) instead of ``cout``-wide (16 or 1) output tiles, which cap
-    every direct formulation at ~12 TFLOP/s measured. Worth it for small
-    grids; see `conv4d` impl='tlc'.
+    Rows index input-l positions, cols output-l positions. The square case
+    (n_rows = n_cols = l, offset = kl//2) is the dense Toeplitz of
+    impl='tlc'; the rectangular case (n_rows = block window, offset = 0)
+    is the per-block band of impl='btl'.
     """
     ki, kj, kk, kl, cin, cout = w.shape
-    pad = kl // 2
-    lp = jnp.arange(l_size)[:, None]
-    lo = jnp.arange(l_size)[None, :]
-    dl = lp - lo + pad  # [l', l]
+    r = jnp.arange(n_rows)[:, None]
+    c = jnp.arange(n_cols)[None, :]
+    dl = r - c + offset  # [n_rows, n_cols]
     valid = (dl >= 0) & (dl < kl)
-    # take along the kl axis: [ki,kj,kk, l',l, cin,cout]
     t = jnp.take(w, jnp.clip(dl, 0, kl - 1), axis=3)
     t = jnp.where(valid[None, None, None, :, :, None, None], t, 0)
-    # -> [ki,kj,kk, l', cin, l, cout] -> [ki,kj,kk, l'*cin, l*cout]
+    # [ki,kj,kk, rows, cols, cin, cout] -> [.., rows*cin, cols*cout]
     t = t.transpose(0, 1, 2, 3, 5, 4, 6)
-    return t.reshape(ki, kj, kk, l_size * cin, l_size * cout)
+    return t.reshape(ki, kj, kk, n_rows * cin, n_cols * cout)
+
+
+def _toeplitz_l_weights(w, l_size):
+    """Dense banded matrix over the full l dim (impl='tlc').
+
+    Inflates FLOPs by ``l_size / kl`` (5x at the training grid 25) but
+    gives the MXU full 128-lane tiles (l*c = l*o = 400 at the PF-Pascal
+    config) instead of ``cout``-wide (16 or 1) output tiles, which cap
+    every direct formulation at ~12 TFLOP/s measured.
+    """
+    return _banded_weights(w, l_size, l_size, w.shape[3] // 2)
 
 
 def _conv4d_tlc(x, w):
@@ -77,6 +81,51 @@ def _conv4d_tlc(x, w):
         preferred_element_type=x.dtype,
     )
     return out.reshape(b, i, j, k, l, cout)
+
+
+def _conv4d_btl(x, w, block=8):
+    """Blocked-Toeplitz conv4d: conv3d over (i, j, k) with the l dim split
+    into blocks of ``block``; each block's band window (block + kl - 1
+    columns) folds into input channels and the block's outputs into output
+    channels.
+
+    Same wide-lane idea as 'tlc' (dense Toeplitz, l/kl = 5x FLOP
+    inflation at the training grid) but banded per block: inflation drops
+    to ``ceil(l/block)*block/l * (block+kl-1)/kl`` (~3.1x at l=25,
+    block=8) while keeping in/out channel lanes at 192/128 for the
+    16-channel NC layers.
+    """
+    b, i, j, k, l, cin = x.shape
+    ki, kj, kk, kl, _, cout = w.shape
+    pad = kl // 2
+    nb = -(-l // block)
+    lpad = nb * block
+    window = block + kl - 1
+    # pad l by the band halo on the left and (halo + round-up) on the right
+    xp = jnp.pad(
+        x, ((0, 0),) * 4 + ((pad, lpad - l + pad), (0, 0))
+    )  # l axis length lpad + 2*pad
+    # windows: block lb covers padded-l [lb*block, lb*block + window)
+    xw = jnp.stack(
+        [xp[:, :, :, :, lb * block : lb * block + window] for lb in range(nb)],
+        axis=1,
+    )  # [b, nb, i, j, k, window, cin]
+    xw = xw.reshape(b * nb, i, j, k, window * cin)
+    t = _banded_weights(w, window, block, 0).astype(x.dtype)
+    dn = lax.conv_dimension_numbers(
+        xw.shape, t.shape, ("NijkC", "ijkIO", "NijkC")
+    )
+    y = lax.conv_general_dilated(
+        xw,
+        t,
+        window_strides=(1, 1, 1),
+        padding="SAME",
+        dimension_numbers=dn,
+        preferred_element_type=x.dtype,
+    )  # [b*nb, i, j, k, block*cout]
+    y = y.reshape(b, nb, i, j, k, block, cout)
+    y = jnp.moveaxis(y, 1, 4).reshape(b, i, j, k, nb * block, cout)
+    return y[:, :, :, :, :l]
 
 
 def _conv4d_xla(x, w):
@@ -512,7 +561,8 @@ def conv4d(x, w, bias=None, impl="xla", interpret=None):
         semantics, lib/conv4d.py:41-48).
       impl: 'xla' (one rank-4 conv HLO) | 'taps' (per-tap conv3d sum) |
         'scan' (sequential over i, minimal memory) | 'tlc' (Toeplitz-l
-        conv3d, 5x FLOPs but wide lanes) | 'tf3'/'tf2' (taps folded into
+        conv3d, 5x FLOPs but wide lanes) | 'btl' (blocked Toeplitz-l:
+        ~3.1x FLOPs, 192/128-wide lanes) | 'tf3'/'tf2' (taps folded into
         output channels + shift-sum) | 'cf'/'cfs' (taps folded into BOTH
         input and output channels of one conv2d — true FLOPs, wide lanes
         both directions; 'cfs' is the scanned low-memory variant) |
@@ -542,6 +592,8 @@ def conv4d(x, w, bias=None, impl="xla", interpret=None):
         out = _conv4d_scan(x, w)
     elif impl == "tlc":
         out = _conv4d_tlc(x, w)
+    elif impl == "btl":
+        out = _conv4d_btl(x, w)
     elif impl == "tf3":
         out = _conv4d_tapsfused3(x, w)
     elif impl == "tf2":

@@ -59,8 +59,8 @@ def main():
                    help="join a multi-host JAX runtime (TPU pod slices: "
                         "auto-detected); shards the data loaders per host")
     p.add_argument("--conv4d_impl", type=str, default="tlc",
-                   choices=["xla", "taps", "scan", "tlc", "tf3", "tf2",
-                            "cf", "cfs", "gemm", "gemms", "pallas"])
+                   choices=["xla", "taps", "scan", "tlc", "btl", "tf3",
+                            "tf2", "cf", "cfs", "gemm", "gemms", "pallas"])
     args = p.parse_args()
 
     host_id, n_hosts = 0, 1

@@ -35,8 +35,8 @@ def conv4d_bruteforce(x, w, bias=None):
 
 @pytest.mark.parametrize(
     "impl",
-    ["xla", "taps", "scan", "tlc", "tf3", "tf2", "cf", "cfs", "gemm",
-     "gemms", "pallas"],
+    ["xla", "taps", "scan", "tlc", "btl", "tf3", "tf2", "cf", "cfs",
+     "gemm", "gemms", "pallas"],
 )
 @pytest.mark.parametrize("ksize,cin,cout", [(3, 1, 2), (5, 2, 1)])
 def test_conv4d_matches_bruteforce(impl, ksize, cin, cout):
@@ -51,8 +51,8 @@ def test_conv4d_matches_bruteforce(impl, ksize, cin, cout):
 
 @pytest.mark.parametrize(
     "impl",
-    ["taps", "scan", "tlc", "tf3", "tf2", "cf", "cfs", "gemm", "gemms",
-     "pallas"],
+    ["taps", "scan", "tlc", "btl", "tf3", "tf2", "cf", "cfs", "gemm",
+     "gemms", "pallas"],
 )
 def test_conv4d_impls_agree_with_grad(impl):
     rng = np.random.RandomState(1)
@@ -69,6 +69,19 @@ def test_conv4d_impls_agree_with_grad(impl):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(bgrad), rtol=1e-3, atol=1e-4
         )
+
+
+@pytest.mark.parametrize("l", [9, 16, 25])
+def test_conv4d_btl_multiblock(l):
+    """btl's default block is 8, so the l<=6 shapes of the shared tests
+    degenerate to a single block; these sizes exercise the inter-block
+    window stacking, reshape and trailing :l slice (l=25 = training grid)."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(1, 3, 3, 3, l, 2).astype(np.float32)
+    w = rng.randn(5, 5, 5, 5, 2, 3).astype(np.float32)
+    want = np.asarray(conv4d(jnp.asarray(x), jnp.asarray(w), impl="xla"))
+    got = np.asarray(conv4d(jnp.asarray(x), jnp.asarray(w), impl="btl"))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
 def test_conv4d_matches_torch_conv3d_decomposition():
