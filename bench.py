@@ -23,7 +23,9 @@ remat with the 'nc_conv' save-policy (convs not recomputed in backward) —
 tlc's 5x-inflated wide-lane forward wins end-to-end once the policy stops
 the backward from re-running forwards; cfs + chunk 4 = 10.5. The blocked
 Toeplitz 'btl' (3.1x inflation, 192/128 lanes) measures 11.0 at chunk 4 —
-the per-block window gather costs what the FLOP reduction saves.
+the per-block window gather costs what the FLOP reduction saves. 'tlcv'
+(tlc forward + custom-VJP true-FLOP rank-4 kernel gradient) measures 6.5:
+the rank-4 dw is slower than the 5x-inflated Toeplitz dw it replaces.
 
 Baseline: the reference repo publishes no throughput numbers (BASELINE.md).
 ``V100_EST_PAIRS_PER_SEC`` is an analytic estimate for the reference

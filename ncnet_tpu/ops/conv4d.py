@@ -128,6 +128,35 @@ def _conv4d_btl(x, w, block=8):
     return y[:, :, :, :, :l]
 
 
+@jax.custom_vjp
+def _conv4d_tlcv(x, w):
+    """'tlc' forward with a custom VJP: dx reuses the wide-lane Toeplitz
+    conv (a conv4d identity with flipped, channel-transposed filters), but
+    dw bypasses the dense-Toeplitz gradient — autodiff through 'tlc' pays
+    the 5x FLOP inflation AGAIN for the [.., l*c, l*o] matrix gradient,
+    while the true kernel gradient is the rank-4 conv's (XLA computes it
+    at the original [k^4, cin, cout] size)."""
+    return _conv4d_tlc(x, w)
+
+
+def _conv4d_tlcv_fwd(x, w):
+    return _conv4d_tlc(x, w), (x, w)
+
+
+def _conv4d_tlcv_bwd(res, g):
+    x, w = res
+    w_flip = jnp.flip(w, axis=(0, 1, 2, 3)).transpose(0, 1, 2, 3, 5, 4)
+    dx = _conv4d_tlc(g, w_flip.astype(g.dtype))
+    # conv4d is linear in w: transpose directly (jax.vjp would evaluate
+    # and discard a full extra primal forward outside jit)
+    transpose_w = jax.linear_transpose(lambda ww: _conv4d_xla(x, ww), w)
+    (dw,) = transpose_w(g)
+    return dx, dw
+
+
+_conv4d_tlcv.defvjp(_conv4d_tlcv_fwd, _conv4d_tlcv_bwd)
+
+
 def _conv4d_xla(x, w):
     dn = lax.conv_dimension_numbers(
         x.shape, w.shape, ("NijklC", "ijklIO", "NijklC")
@@ -562,7 +591,10 @@ def conv4d(x, w, bias=None, impl="xla", interpret=None):
       impl: 'xla' (one rank-4 conv HLO) | 'taps' (per-tap conv3d sum) |
         'scan' (sequential over i, minimal memory) | 'tlc' (Toeplitz-l
         conv3d, 5x FLOPs but wide lanes) | 'btl' (blocked Toeplitz-l:
-        ~3.1x FLOPs, 192/128-wide lanes) | 'tf3'/'tf2' (taps folded into
+        ~3.1x FLOPs, 192/128-wide lanes) | 'tlcv' (tlc forward + custom
+        VJP with a true-FLOP rank-4 kernel gradient — measured SLOWER
+        end-to-end than tlc, kept as a documented negative result) |
+        'tf3'/'tf2' (taps folded into
         output channels + shift-sum) | 'cf'/'cfs' (taps folded into BOTH
         input and output channels of one conv2d — true FLOPs, wide lanes
         both directions; 'cfs' is the scanned low-memory variant) |
@@ -594,6 +626,8 @@ def conv4d(x, w, bias=None, impl="xla", interpret=None):
         out = _conv4d_tlc(x, w)
     elif impl == "btl":
         out = _conv4d_btl(x, w)
+    elif impl == "tlcv":
+        out = _conv4d_tlcv(x, w)
     elif impl == "tf3":
         out = _conv4d_tapsfused3(x, w)
     elif impl == "tf2":

@@ -35,8 +35,8 @@ def conv4d_bruteforce(x, w, bias=None):
 
 @pytest.mark.parametrize(
     "impl",
-    ["xla", "taps", "scan", "tlc", "btl", "tf3", "tf2", "cf", "cfs",
-     "gemm", "gemms", "pallas"],
+    ["xla", "taps", "scan", "tlc", "btl", "tlcv", "tf3", "tf2", "cf",
+     "cfs", "gemm", "gemms", "pallas"],
 )
 @pytest.mark.parametrize("ksize,cin,cout", [(3, 1, 2), (5, 2, 1)])
 def test_conv4d_matches_bruteforce(impl, ksize, cin, cout):
@@ -51,8 +51,8 @@ def test_conv4d_matches_bruteforce(impl, ksize, cin, cout):
 
 @pytest.mark.parametrize(
     "impl",
-    ["taps", "scan", "tlc", "btl", "tf3", "tf2", "cf", "cfs", "gemm",
-     "gemms", "pallas"],
+    ["taps", "scan", "tlc", "btl", "tlcv", "tf3", "tf2", "cf", "cfs",
+     "gemm", "gemms", "pallas"],
 )
 def test_conv4d_impls_agree_with_grad(impl):
     rng = np.random.RandomState(1)
