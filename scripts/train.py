@@ -59,8 +59,16 @@ def main():
                    help="join a multi-host JAX runtime (TPU pod slices: "
                         "auto-detected); shards the data loaders per host")
     p.add_argument("--conv4d_impl", type=str, default="tlc",
-                   choices=["xla", "taps", "scan", "tlc", "btl", "tf3",
-                            "tf2", "cf", "cfs", "gemm", "gemms", "pallas"])
+                   choices=["xla", "taps", "scan", "tlc", "btl", "tlcv",
+                            "tf3", "tf2", "cf", "cfs", "gemm", "gemms",
+                            "pallas"])
+    p.add_argument("--loss_chunk", type=int, default=None,
+                   help="run the correlation->NC->score loss over sample "
+                        "chunks of this size (0 = whole batch; when "
+                        "resuming, unset keeps the checkpoint's value). "
+                        "The measured-best single-chip config is 8 (see "
+                        "bench.py); leave unset for multi-device data "
+                        "parallelism")
     args = p.parse_args()
 
     host_id, n_hosts = 0, 1
@@ -115,15 +123,21 @@ def main():
                 "(e.g. a raw torchvision .pth) use --fe_weights"
             )
         config, params = convert_checkpoint(args.checkpoint)
+        chunk = args.loss_chunk or 0
         config = config.replace(
             half_precision=args.bf16, conv4d_impl=args.conv4d_impl,
-            nc_remat=True,
+            loss_chunk=chunk, nc_remat=chunk == 0,
         )
         print(f"initialized from reference checkpoint {args.checkpoint} "
               "(weights-only: torch optimizer state is not portable)")
     elif args.checkpoint:
         ck = load_checkpoint(args.checkpoint)
         config, params = ck.config, ck.params
+        if args.loss_chunk is not None:  # explicit flag overrides
+            config = config.replace(
+                loss_chunk=args.loss_chunk,
+                nc_remat=args.loss_chunk == 0,
+            )
         start_epoch = ck.epoch
         start_step = ck.step
         opt_state = ck.opt_state  # raw state dict; train() restores into shape
@@ -139,9 +153,29 @@ def main():
             ncons_channels=tuple(args.ncons_channels),
             half_precision=args.bf16,
             conv4d_impl=args.conv4d_impl,
-            nc_remat=True,
+            loss_chunk=args.loss_chunk or 0,
+            # chunking brings its own conv-saving remat policy; per-layer
+            # remat is the memory bound for the unchunked path
+            nc_remat=not args.loss_chunk,
         )
         params = init_immatchnet(jax.random.PRNGKey(args.seed), config)
+
+    # validate the EFFECTIVE chunking (wherever the config came from)
+    # against the batch: weak_loss treats chunk >= batch as unchunked, so
+    # remat must come from nc_remat in that case, and partial chunks raise
+    if config.loss_chunk:
+        if config.loss_chunk >= args.batch_size:
+            print(
+                f"loss_chunk {config.loss_chunk} >= batch {args.batch_size}: "
+                "running unchunked with per-layer remat",
+                flush=True,
+            )
+            config = config.replace(loss_chunk=0, nc_remat=True)
+        elif args.batch_size % config.loss_chunk:
+            p.error(
+                f"batch size {args.batch_size} must be divisible by "
+                f"loss_chunk {config.loss_chunk}"
+            )
 
     if args.fe_weights:
         from ncnet_tpu.utils.convert_torch import load_trunk_weights
