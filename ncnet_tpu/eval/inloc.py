@@ -66,7 +66,7 @@ def load_and_preprocess(path, image_size, k_size, grid_multiple=None):
     return normalize_image_np(img)[None]  # [1, h, w, 3]
 
 
-def make_match_fn(config, mesh=None):
+def make_match_fn(config, mesh=None, softmax=True):
     """(params, src, tgt) -> (fwd, rev) match tuples for one pair (jittable).
 
     With ``mesh`` (a Mesh with a 'spatial' axis), the correlation/NC
@@ -95,7 +95,10 @@ def make_match_fn(config, mesh=None):
     def fn(params, src, tgt):
         out = forward(params, src, tgt)
         corr, delta4d = out if k > 1 else (out, None)
-        kw = dict(scale="positive", do_softmax=True, delta4d=delta4d, k_size=max(k, 1))
+        kw = dict(
+            scale="positive", do_softmax=softmax, delta4d=delta4d,
+            k_size=max(k, 1),
+        )
         fwd = corr_to_matches(corr, **kw)
         rev = corr_to_matches(corr, invert_matching_direction=True, **kw)
         return fwd, rev
@@ -163,6 +166,7 @@ def dump_matches(
     flip_direction=False,
     verbose=True,
     mesh=None,
+    softmax=True,
 ):
     """Run the full dump. Writes ``<output_dir>/<q+1>.mat`` per query.
 
@@ -183,7 +187,7 @@ def dump_matches(
     pano_fn_all = np.vstack(tuple(db[q][1] for q in range(len(db))))
 
     os.makedirs(output_dir, exist_ok=True)
-    jitted = jax.jit(make_match_fn(config, mesh=mesh))
+    jitted = jax.jit(make_match_fn(config, mesh=mesh, softmax=softmax))
     stride = backbone_stride(config.feature_extraction_cnn)
 
     n_slots = n_match_slots(image_size, k_size, both_directions)

@@ -33,6 +33,10 @@ class CheckpointData:
     train_loss: Any = None
     val_loss: Any = None
     best_val_loss: Optional[float] = None
+    # which params were training (shapes the opt_state pytree): resume must
+    # rebuild the same trainable subset or from_state_dict fails opaquely
+    train_fe: bool = False
+    fe_finetune_blocks: int = 0
 
 
 def _to_numpy(tree):
@@ -68,6 +72,8 @@ def save_checkpoint(path, data: CheckpointData, is_best=False):
         "best_val_loss": float(
             data.best_val_loss if data.best_val_loss is not None else np.inf
         ),
+        "train_fe": bool(data.train_fe),
+        "fe_finetune_blocks": int(data.fe_finetune_blocks),
     }
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with open(path, "wb") as f:
@@ -96,4 +102,6 @@ def load_checkpoint(path, opt_state_target=None) -> CheckpointData:
         train_loss=payload.get("train_loss"),
         val_loss=payload.get("val_loss"),
         best_val_loss=payload.get("best_val_loss"),
+        train_fe=bool(payload.get("train_fe", False)),
+        fe_finetune_blocks=int(payload.get("fe_finetune_blocks", 0)),
     )

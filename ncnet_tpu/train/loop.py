@@ -45,6 +45,7 @@ def train(
     num_epochs=5,
     learning_rate=5e-4,
     train_fe=False,
+    fe_finetune_blocks=0,
     checkpoint_dir="trained_models",
     checkpoint_name="ncnet_tpu.msgpack",
     data_parallel=True,
@@ -65,7 +66,11 @@ def train(
         params = replicate(mesh, params)
 
     optimizer = make_optimizer(learning_rate)
-    state = create_train_state(params, optimizer, train_fe, step=start_step)
+    state = create_train_state(
+        params, optimizer, train_fe, step=start_step,
+        fe_finetune_blocks=fe_finetune_blocks,
+        cnn=config.feature_extraction_cnn,
+    )
     if opt_state is not None:
         if isinstance(opt_state, dict):
             # raw state dict from a checkpoint loaded without a target
@@ -76,7 +81,9 @@ def train(
     if mesh is not None:
         state = state._replace(opt_state=replicate(mesh, state.opt_state))
 
-    train_step = make_train_step(config, optimizer, train_fe)
+    train_step = make_train_step(
+        config, optimizer, train_fe, fe_finetune_blocks=fe_finetune_blocks
+    )
     eval_step = make_eval_step(config)
 
     best_val = float("inf") if initial_best_val is None else float(initial_best_val)
@@ -157,6 +164,8 @@ def train(
                 train_loss=np.asarray(train_hist),
                 val_loss=np.asarray(val_hist),
                 best_val_loss=best_val,
+                train_fe=train_fe,
+                fe_finetune_blocks=fe_finetune_blocks,
             ),
             is_best=is_best,
         )

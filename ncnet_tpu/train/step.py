@@ -20,24 +20,99 @@ class TrainState(NamedTuple):
     step: Any
 
 
-def trainable_subset(params, train_fe=False):
-    """The trainable sub-pytree: the NC head, plus the trunk if train_fe."""
+def _finetune_tail_blocks(fe_params, cnn):
+    """Deepest-to-shallowest trainable units of the trunk's tail — what
+    ``fe_finetune_params`` counts backwards over (the reference unfreezes
+    ``FeatureExtraction.model[-1][-(i+1)]``, train.py:60-63: the trailing
+    children of the LAST trunk module).
+
+    resnet101: layer3's bottleneck blocks. vgg: the flat conv list.
+    densenet201: the trunk ends with transition2, so that is the last
+    unit, preceded by denseblock2's denselayers.
+
+    Returns ``(blocks, write)`` where ``write(fe, new_blocks)`` produces a
+    new fe tree with the block list replaced.
+    """
+    if isinstance(fe_params, list):  # vgg: flat conv list
+
+        def write_vgg(fe, new_blocks):
+            return list(new_blocks)
+
+        return list(fe_params), write_vgg
+    if cnn == "resnet101":
+
+        def write_resnet(fe, new_blocks):
+            out = dict(fe)
+            out["layer3"] = list(new_blocks)
+            return out
+
+        return list(fe_params["layer3"]), write_resnet
+    if cnn == "densenet201":
+
+        def write_densenet(fe, new_blocks):
+            out = dict(fe)
+            out["denseblock2"] = list(new_blocks[:-1])
+            out["transition2"] = new_blocks[-1]
+            return out
+
+        return (
+            list(fe_params["denseblock2"]) + [fe_params["transition2"]],
+            write_densenet,
+        )
+    raise ValueError(f"no finetune tail defined for backbone {cnn!r}")
+
+
+def trainable_subset(params, train_fe=False, fe_finetune_blocks=0,
+                     cnn="resnet101"):
+    """The trainable sub-pytree: the NC head, plus the whole trunk if
+    ``train_fe``, plus the last ``fe_finetune_blocks`` tail units of the
+    trunk otherwise."""
     if train_fe:
         return dict(params)
-    return {"neigh_consensus": params["neigh_consensus"]}
+    sub = {"neigh_consensus": params["neigh_consensus"]}
+    if fe_finetune_blocks > 0:
+        blocks, _ = _finetune_tail_blocks(params["feature_extraction"], cnn)
+        if fe_finetune_blocks > len(blocks):
+            # the reference would IndexError past model[-1]'s children; a
+            # silent clamp would train a different set than asked
+            raise ValueError(
+                f"fe_finetune_blocks={fe_finetune_blocks} exceeds the "
+                f"{len(blocks)} tail units of the {cnn} trunk"
+            )
+        sub["fe_tail"] = blocks[-fe_finetune_blocks:]
+    return sub
+
+
+def merge_trainable(params, trainable, cnn="resnet101"):
+    """Inverse of `trainable_subset`: write the trainable sub-pytree back
+    into a full param tree (pure; no mutation)."""
+    t = dict(trainable)
+    tail = t.pop("fe_tail", None)
+    out = dict(params)
+    out.update(t)
+    if tail is not None:
+        fe = params["feature_extraction"]
+        blocks, write = _finetune_tail_blocks(fe, cnn)
+        blocks[-len(tail):] = tail
+        out["feature_extraction"] = write(fe, blocks)
+    return out
 
 
 def make_optimizer(learning_rate=5e-4):
     return optax.adam(learning_rate)
 
 
-def create_train_state(params, optimizer, train_fe=False, step=0):
-    opt_state = optimizer.init(trainable_subset(params, train_fe))
+def create_train_state(params, optimizer, train_fe=False, step=0,
+                       fe_finetune_blocks=0, cnn="resnet101"):
+    opt_state = optimizer.init(
+        trainable_subset(params, train_fe, fe_finetune_blocks, cnn)
+    )
     return TrainState(params=params, opt_state=opt_state, step=step)
 
 
 def make_train_step(
-    config, optimizer, train_fe=False, normalization="softmax", donate=True
+    config, optimizer, train_fe=False, normalization="softmax", donate=True,
+    fe_finetune_blocks=0,
 ):
     """Returns ``step(state, batch) -> (state, loss)``, jit-compiled.
 
@@ -46,19 +121,20 @@ def make_train_step(
     sharded over the data axis and params replicated, XLA inserts the
     gradient all-reduce automatically; no hand-written collectives needed.
     """
+    cnn = config.feature_extraction_cnn
 
     def loss_fn(trainable, params, batch):
-        merged = dict(params)
-        merged.update(trainable)
+        merged = merge_trainable(params, trainable, cnn)
         return weak_loss(merged, config, batch, normalization)
 
     def step_fn(state, batch):
-        trainable = trainable_subset(state.params, train_fe)
+        trainable = trainable_subset(
+            state.params, train_fe, fe_finetune_blocks, cnn
+        )
         loss, grads = jax.value_and_grad(loss_fn)(trainable, state.params, batch)
         updates, opt_state = optimizer.update(grads, state.opt_state, trainable)
         new_trainable = optax.apply_updates(trainable, updates)
-        params = dict(state.params)
-        params.update(new_trainable)
+        params = merge_trainable(state.params, new_trainable, cnn)
         return (
             TrainState(params=params, opt_state=opt_state, step=state.step + 1),
             loss,

@@ -23,6 +23,9 @@ def main():
     def str2bool(v):
         return str(v).lower() in ("1", "true", "yes", "y")
 
+    p.add_argument("--softmax", type=str2bool, default=True,
+                   help="softmax-normalize match scores over the source "
+                        "dim (reference eval_inloc.py --softmax)")
     p.add_argument("--matching_both_directions", type=str2bool, default=True)
     p.add_argument("--flip_matching_direction", type=str2bool, default=False)
     p.add_argument("--pano_path", type=str, default="datasets/inloc/pano/")
@@ -57,7 +60,8 @@ def main():
     exp += "_BOTHDIRS" if args.matching_both_directions else (
         "_AtoB" if args.flip_matching_direction else "_BtoA"
     )
-    exp += "_SOFTMAX"
+    if args.softmax:
+        exp += "_SOFTMAX"
     if args.checkpoint:
         exp += "_CHECKPOINT_" + os.path.basename(args.checkpoint).split(".")[0]
     out_dir = os.path.join(args.output_root, exp)
@@ -96,6 +100,7 @@ def main():
         flip_direction=args.flip_matching_direction
         and not args.matching_both_directions,
         mesh=mesh,
+        softmax=args.softmax,
     )
 
 

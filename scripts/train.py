@@ -38,6 +38,9 @@ def main():
     p.add_argument("--ncons_channels", nargs="+", type=int, default=[16, 16, 1])
     p.add_argument("--fe_arch", type=str, default="resnet101")
     p.add_argument("--train_fe", action="store_true")
+    p.add_argument("--fe_finetune_params", type=int, default=0,
+                   help="finetune the last N blocks of the trunk's final "
+                        "stage (reference train.py:60-63 semantics)")
     p.add_argument("--fe_weights", type=str, default="",
                    help="pretrained trunk weights: reference .pth.tar, raw "
                         "torchvision state dict (.pth), or ncnet_tpu .msgpack")
@@ -138,6 +141,25 @@ def main():
                 loss_chunk=args.loss_chunk,
                 nc_remat=args.loss_chunk == 0,
             )
+        # the checkpoint records WHICH params were training (the opt-state
+        # pytree shape depends on it); default flags adopt its mode, an
+        # explicit different mode restarts the optimizer
+        if not args.train_fe and not args.fe_finetune_params:
+            args.train_fe = ck.train_fe
+            args.fe_finetune_params = ck.fe_finetune_blocks
+        elif (args.train_fe, args.fe_finetune_params) != (
+            ck.train_fe, ck.fe_finetune_blocks
+        ):
+            print(
+                "finetune mode differs from the checkpoint "
+                f"(ckpt: train_fe={ck.train_fe}, "
+                f"fe_finetune_blocks={ck.fe_finetune_blocks}); "
+                "starting a fresh optimizer state",
+                flush=True,
+            )
+            import dataclasses
+
+            ck = dataclasses.replace(ck, opt_state=None)
         start_epoch = ck.epoch
         start_step = ck.step
         opt_state = ck.opt_state  # raw state dict; train() restores into shape
@@ -221,6 +243,7 @@ def main():
         num_epochs=args.num_epochs,
         learning_rate=args.lr,
         train_fe=args.train_fe,
+        fe_finetune_blocks=args.fe_finetune_params,
         checkpoint_dir=args.result_model_dir,
         checkpoint_name=args.result_model_fn,
         start_epoch=start_epoch,

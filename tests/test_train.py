@@ -135,6 +135,45 @@ def test_checkpoint_resume_with_opt_state(tmp_path):
     )
 
 
+def test_fe_finetune_updates_only_tail_blocks():
+    """fe_finetune_params semantics (reference train.py:60-63): the last N
+    blocks of the trunk's final stage train; everything earlier stays
+    frozen."""
+    params = init_immatchnet(jax.random.PRNGKey(0), CFG)
+    opt = make_optimizer(1e-3)
+    state = create_train_state(params, opt, fe_finetune_blocks=2)
+    step = make_train_step(CFG, opt, donate=False, fe_finetune_blocks=2)
+    new_state, loss = step(state, _batch(np.random.RandomState(6)))
+    assert np.isfinite(float(loss))
+
+    old_l3 = params["feature_extraction"]["layer3"]
+    new_l3 = new_state.params["feature_extraction"]["layer3"]
+    # last 2 blocks moved
+    for ob, nb in zip(old_l3[-2:], new_l3[-2:]):
+        assert any(
+            not np.allclose(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree.leaves(ob), jax.tree.leaves(nb))
+        )
+    # earlier blocks and stages frozen
+    for ob, nb in zip(old_l3[:-2], new_l3[:-2]):
+        for a, b in zip(jax.tree.leaves(ob), jax.tree.leaves(nb)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for key in ("conv1", "bn1", "layer1", "layer2"):
+        for a, b in zip(
+            jax.tree.leaves(params["feature_extraction"][key]),
+            jax.tree.leaves(new_state.params["feature_extraction"][key]),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # NC head still trains
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree.leaves(params["neigh_consensus"]),
+            jax.tree.leaves(new_state.params["neigh_consensus"]),
+        )
+    )
+
+
 def test_chunked_loss_with_save_policy_matches_unchunked():
     """The loss_chunk + save_only_these_names('nc_conv') remat path must be
     a pure performance transform: loss AND gradients identical to the
