@@ -63,6 +63,22 @@ def test_match_pair_rectangular(tiny):
     assert np.all(score >= 0) and np.all(score <= 1)
 
 
+def test_match_fn_softmax_toggle(tiny):
+    """--softmax False (reference eval_inloc.py flag): raw correlation
+    scores instead of softmax probabilities — coordinates unchanged."""
+    rng = np.random.RandomState(5)
+    src = jnp.asarray(rng.randn(1, 64, 64, 3).astype(np.float32))
+    tgt = jnp.asarray(rng.randn(1, 64, 64, 3).astype(np.float32))
+    fwd_sm, _ = jax.jit(make_match_fn(TINY, softmax=True))(tiny, src, tgt)
+    fwd_raw, _ = jax.jit(make_match_fn(TINY, softmax=False))(tiny, src, tgt)
+    # same argmax coordinates (softmax is monotone along the source dim
+    # it normalizes, so the per-cell best match is unchanged)...
+    for a, b in zip(fwd_sm[:4], fwd_raw[:4]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # ...different score scale (probabilities vs raw correlations)
+    assert not np.allclose(np.asarray(fwd_sm[4]), np.asarray(fwd_raw[4]))
+
+
 def test_match_pair_relocalization(tiny):
     cfg = TINY.replace(relocalization_k_size=2)
     rng = np.random.RandomState(1)
