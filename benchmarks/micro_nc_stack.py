@@ -1,0 +1,138 @@
+"""Microbenchmark: the full PF-Pascal NC stack (1->16->16->1, 5^4 kernels)
+with PER-LAYER conv4d implementation choices, honest slope timing.
+
+Motivation (round 3): the uniform-impl sweep showed every formulation caps
+at ~20-30 TFLOP/s useful f+b — but the three layers have very different
+shapes. The middle 16->16 layer carries 89% of the stack's true FLOPs and
+offers 80-wide lanes to the true-FLOP channel-fused forms, while the 1->16
+and 16->1 edge layers (11% of FLOPs) are where the 5x-inflated Toeplitz
+form pays least in absolute terms. Mixing was never measured before.
+
+Usage: python benchmarks/micro_nc_stack.py --combos tlc,tlc,tlc tlc,cf,tlc
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from timing import time_chain
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=16,
+                   help="net batch (loss chunk x2 for the symmetric pass)")
+    p.add_argument("--grid", type=int, default=25)
+    p.add_argument("--ksize", type=int, default=5)
+    p.add_argument("--channels", default="16,16,1")
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--grad", action="store_true")
+    p.add_argument(
+        "combos", nargs="*",
+        default=["tlc,tlc,tlc", "tlc,cf,tlc", "tlc,cfs,tlc", "tlc,gemm,tlc",
+                 "tlc,btl,tlc", "tlc,tf3,tlc", "cf,cf,cf", "tlc,xla,tlc"],
+        help="comma-separated per-layer impls",
+    )
+    args = p.parse_args()
+
+    from ncnet_tpu.ops.conv4d import conv4d_packed
+
+    b, g, k = args.batch, args.grid, args.ksize
+    channels = [int(c) for c in args.channels.split(",")]
+    dtype = jnp.dtype(args.dtype)
+    rng = np.random.RandomState(0)
+    x0 = jnp.asarray(rng.randn(b, g, g, g * g * 1), dtype)  # packed, cin=1
+
+    ws, bs = [], []
+    cin = 1
+    for cout in channels:
+        ws.append(
+            jnp.asarray(
+                rng.randn(k, k, k, k, cin, cout) * (cin * k**4) ** -0.5, dtype
+            )
+        )
+        bs.append(jnp.asarray(rng.randn(cout) * 0.01, dtype))
+        cin = cout
+
+    layer_flops = []
+    cin = 1
+    for cout in channels:
+        layer_flops.append(2.0 * b * g**4 * k**4 * cin * cout)
+        cin = cout
+    flops = sum(layer_flops)
+    print(
+        f"NC stack [{b},{g}^4] ch 1->{'->'.join(map(str, channels))} "
+        f"k={k}^4 {dtype.name}: {flops / 1e12:.3f} TFLOP fwd "
+        f"(layers: {[round(f / 1e12, 3) for f in layer_flops]})"
+    )
+
+    def stack(xp, ws_, bs_, impls):
+        for w, bias, impl in zip(ws_, bs_, impls):
+            xp = conv4d_packed(xp, w, (g, g), bias, impl=impl)
+            xp = jax.nn.relu(xp)
+        return xp
+
+    for combo in args.combos:
+        impls = combo.split(",")
+        assert len(impls) == len(channels), combo
+
+        def make_fwd_chain(n, impls=impls):
+            @jax.jit
+            def f(xp, ws_, bs_):
+                acc = xp
+                for _ in range(n):
+                    # cout=1 -> packed out dim k*l*1 == packed in dim: chain
+                    acc = acc + stack(acc, ws_, bs_, impls)
+                return acc
+
+            return f, (x0, ws, bs)
+
+        try:
+            dt = time_chain(make_fwd_chain)
+        except Exception as e:
+            print(f"  {combo:14s}: FAILED {type(e).__name__}: {str(e)[:100]}")
+            continue
+        print(
+            f"  {combo:14s} fwd : {dt * 1e3:8.2f} ms  "
+            f"{flops / dt / 1e12:7.2f} TFLOP/s useful"
+        )
+        if not args.grad:
+            continue
+
+        def make_grad_chain(n, impls=impls):
+            def loss(xp, ws_, bs_):
+                return jnp.sum(stack(xp, ws_, bs_, impls).astype(jnp.float32))
+
+            gradf = jax.grad(loss, argnums=(0, 1))
+
+            @jax.jit
+            def f(xp, ws_, bs_):
+                xx, ww = xp, ws_
+                for _ in range(n):
+                    dx, dw = gradf(xx, ww, bs_)
+                    xx = xx + 1e-3 * dx.astype(dtype)
+                    ww = [w + 1e-3 * d.astype(dtype) for w, d in zip(ww, dw)]
+                return xx
+
+            return f, (x0, ws, bs)
+
+        try:
+            dt = time_chain(make_grad_chain)
+        except Exception as e:
+            print(f"  {combo:14s}: grad FAILED {type(e).__name__}: {str(e)[:100]}")
+            continue
+        print(
+            f"  {combo:14s} f+b : {dt * 1e3:8.2f} ms  "
+            f"{3 * flops / dt / 1e12:7.2f} TFLOP/s useful (3x fwd FLOPs)"
+        )
+
+
+if __name__ == "__main__":
+    main()

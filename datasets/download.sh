@@ -27,6 +27,7 @@ case "${1:-all}" in
     ;;&
   inloc|all)
     (
+      mkdir -p inloc
       cd inloc
       wget -nc http://www.ok.sc.e.titech.ac.jp/INLOC/materials/cutouts.tar.gz
       wget -nc http://www.ok.sc.e.titech.ac.jp/INLOC/materials/iphone7.tar.gz

@@ -18,10 +18,12 @@ whole benchmark runs without MATLAB:
     of queries with position error under a sweep of thresholds (0..2 m),
     orientation error gated at 10 degrees.
 
-The dense pose-verification re-ranking stage (parfor_nc4d_PV.m: render
-synthetic views from the scan, DSIFT similarity) depends on the raw laser
-scans + vl_phow and is NOT ported; this module covers the "DensePE +
-NCNet" (PnP-only) curve.
+This module covers the "DensePE + NCNet" (PnP-only) curve. The dense
+pose-verification re-ranking stage (parfor_nc4d_PV.m: render synthetic
+views from the scan, DSIFT similarity) is ported separately in
+`ncnet_tpu/eval/pose_verify.py` (z-buffer splat renderer + dense RootSIFT
+standing in for vl_phow), wired up via `scripts/localize_inloc.py
+--densePV`.
 
 Pure numpy — this is a host-side geometric solver, not an accelerator
 workload (the reference runs it on CPU via MATLAB parfor; parallelize over

@@ -26,9 +26,14 @@ def _finetune_tail_blocks(fe_params, cnn):
     ``FeatureExtraction.model[-1][-(i+1)]``, train.py:60-63: the trailing
     children of the LAST trunk module).
 
-    resnet101: layer3's bottleneck blocks. vgg: the flat conv list.
-    densenet201: the trunk ends with transition2, so that is the last
-    unit, preceded by denseblock2's denselayers.
+    resnet101: layer3's bottleneck blocks — exact reference parity.
+    vgg / densenet201: the reference's indexing is degenerate for these
+    trunks (for densenet201 ``model[-1][-(i+1)]`` walks transition2's
+    pool/conv/relu/norm sublayers; for vgg the last module is a single
+    conv), so the unit granularity here is a framework interpretation:
+    vgg counts over the flat conv list; densenet201 treats transition2 as
+    the last unit, preceded by denseblock2's denselayers. Users comparing
+    finetune configs against the reference should rely on resnet101 only.
 
     Returns ``(blocks, write)`` where ``write(fe, new_blocks)`` produces a
     new fe tree with the block list replaced.

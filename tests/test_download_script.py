@@ -1,0 +1,87 @@
+"""No-network smoke test of datasets/download.sh control flow.
+
+Round-2 finding: the inloc branch did ``cd inloc`` under ``set -e`` with no
+datasets/inloc directory in the repo, so the script died before its wget
+lines. This test runs every branch in a sandbox with stubbed network tools
+and asserts each branch actually reaches its fetch commands.
+"""
+
+import shutil
+import stat
+import subprocess
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+STUB = """#!/bin/bash
+echo "$0 $@" >> "$STUB_LOG"
+exit 0
+"""
+
+
+def _sandbox(tmp_path):
+    ds = tmp_path / "datasets"
+    ds.mkdir()
+    shutil.copy(REPO / "datasets" / "download.sh", ds / "download.sh")
+    (ds / "pf-pascal").mkdir()
+    ivd = ds / "ivd"
+    ivd.mkdir()
+    (ivd / "dirs.txt").write_text("a/b/c venue\nd/e/f venue\n")
+    (ivd / "urls.txt").write_text(
+        "a/b/c/img1.jpg https://example.invalid/img1\n"
+        "d/e/f/img2.jpg https://example.invalid/img2\n"
+    )
+    # note: no inloc/ directory — the script must create it itself
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    for tool in ("wget", "unzip"):
+        p = bin_dir / tool
+        p.write_text(STUB)
+        p.chmod(p.stat().st_mode | stat.S_IXUSR)
+    return ds, bin_dir
+
+
+def _run(ds, bin_dir, tmp_path, arg):
+    log = tmp_path / f"log_{arg.replace('-', '_')}"
+    log.write_text("")
+    env = {
+        "PATH": f"{bin_dir}:/usr/bin:/bin",
+        "STUB_LOG": str(log),
+    }
+    proc = subprocess.run(
+        ["bash", str(ds / "download.sh"), arg],
+        env=env,
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, (
+        f"{arg}: rc={proc.returncode}\nstdout={proc.stdout}\n"
+        f"stderr={proc.stderr}"
+    )
+    return log.read_text()
+
+
+def test_every_branch_reaches_its_fetch_lines(tmp_path):
+    ds, bin_dir = _sandbox(tmp_path)
+    log = _run(ds, bin_dir, tmp_path, "all")
+    assert "PF-dataset-PASCAL.zip" in log, "pf-pascal wget not reached"
+    assert "unzip" in log
+    assert "img1" in log and "img2" in log, "ivd wget not reached"
+    assert "cutouts.tar.gz" in log and "iphone7.tar.gz" in log, (
+        "inloc wget not reached"
+    )
+    assert (ds / "inloc").is_dir(), "inloc dir not created"
+    # ivd venue dirs are created before the downloads
+    assert (ds / "ivd" / "a" / "b" / "c").is_dir()
+
+
+def test_individual_branches(tmp_path):
+    ds, bin_dir = _sandbox(tmp_path)
+    log = _run(ds, bin_dir, tmp_path, "inloc")
+    assert "cutouts.tar.gz" in log
+    assert "PF-dataset-PASCAL" not in log
+    log = _run(ds, bin_dir, tmp_path, "pf-pascal")
+    assert "PF-dataset-PASCAL.zip" in log
+    assert "cutouts" not in log
+    log = _run(ds, bin_dir, tmp_path, "ivd")
+    assert "img1" in log

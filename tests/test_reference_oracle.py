@@ -1,0 +1,485 @@
+"""Parity against the reference's OWN code, imported as the oracle.
+
+Round-2 verdict: the strongest attainable correctness proof in this
+environment is running the reference implementation itself (torch is
+installed; these modules need neither torchvision weights nor a GPU) on
+identical inputs/weights and asserting agreement — converting "we
+transcribed the math carefully" into "the reference itself agrees".
+
+Imports `/root/reference/lib/{conv4d,model,point_tnf,eval_util}.py`
+directly (module-level torchvision/skimage imports are satisfied with
+empty stub modules — those libraries are only exercised by code paths
+these tests never touch), and extracts ``weak_loss`` from the reference's
+``train.py`` source by AST (the file is an argparse script and cannot be
+imported).
+"""
+
+import ast
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+
+REF_ROOT = "/root/reference"
+
+# All conv4d lowerings that run on the CPU test platform.
+CONV4D_IMPLS = [
+    "xla", "taps", "scan", "tlc", "btl", "tlcv", "tf3", "tf2",
+    "cf", "cfs", "gemm", "gemms",
+]
+
+
+def _import_reference():
+    """Import the reference's lib modules with unused heavy deps stubbed."""
+    for name in (
+        "torchvision",
+        "torchvision.models",
+        "skimage",
+        "skimage.io",
+        "skimage.draw",
+    ):
+        if name not in sys.modules:
+            sys.modules[name] = types.ModuleType(name)
+    sys.modules["torchvision"].models = sys.modules["torchvision.models"]
+    sys.modules["skimage"].io = sys.modules["skimage.io"]
+    sys.modules["skimage"].draw = sys.modules["skimage.draw"]
+    if REF_ROOT not in sys.path:
+        sys.path.insert(0, REF_ROOT)
+    import lib.conv4d as ref_conv4d
+    import lib.eval_util as ref_eval_util
+    import lib.model as ref_model
+    import lib.point_tnf as ref_point_tnf
+
+    return ref_conv4d, ref_model, ref_point_tnf, ref_eval_util
+
+
+REF_CONV4D, REF_MODEL, REF_TNF, REF_EVAL = _import_reference()
+
+
+def _extract_weak_loss():
+    """Compile the reference's ``weak_loss`` (train.py:110-156) out of the
+    script source — the module body runs argparse and cannot be imported."""
+    with open(f"{REF_ROOT}/train.py") as f:
+        tree = ast.parse(f.read())
+    fn = next(
+        n
+        for n in tree.body
+        if isinstance(n, ast.FunctionDef) and n.name == "weak_loss"
+    )
+    ns = {"torch": torch, "np": np}
+    exec(compile(ast.Module([fn], []), "train.py", "exec"), ns)
+    return ns["weak_loss"]
+
+
+def _t(x):
+    return torch.from_numpy(np.asarray(x))
+
+
+# ------------------------------------------------------------------ conv4d
+
+
+@pytest.mark.parametrize("impl", CONV4D_IMPLS)
+def test_conv4d_vs_reference_loop(impl):
+    """Every lowering vs the reference's conv3d tap loop
+    (lib/conv4d.py:11-51), including the bias-once semantics, on a
+    non-hypercubic grid."""
+    from ncnet_tpu.ops.conv4d import conv4d
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 6, 5, 4, 7, 3).astype(np.float32)  # [b,i,j,k,l,c]
+    w = rng.randn(3, 3, 3, 3, 3, 5).astype(np.float32) * 0.2
+    bias = rng.randn(5).astype(np.float32)
+
+    with torch.no_grad():
+        want = REF_CONV4D.conv4d(
+            _t(x.transpose(0, 5, 1, 2, 3, 4)),  # [b,c,i,j,k,l]
+            _t(w.transpose(5, 4, 0, 1, 2, 3)),  # [cout,cin,ki,kj,kk,kl]
+            bias=_t(bias),
+            permute_filters=True,
+        ).numpy().transpose(0, 2, 3, 4, 5, 1)
+
+    got = np.asarray(conv4d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(bias), impl=impl))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def _ref_neigh_consensus(ksizes, channels, seed):
+    """Instantiate the reference NeighConsensus on CPU; returns the module.
+
+    torch >= 1.x added a required ``padding_mode`` arg to ``_ConvNd`` that
+    the 0.3-era reference doesn't pass; shim it for the construction only.
+    """
+    try:
+        return REF_MODEL.NeighConsensus(
+            use_cuda=False,
+            kernel_sizes=list(ksizes),
+            channels=list(channels),
+            symmetric_mode=True,
+        )
+    except TypeError:
+        from torch.nn.modules.conv import _ConvNd
+
+        orig = _ConvNd.__init__
+
+        def patched(self, in_c, out_c, ks, st, pad, dil, tr, outp, grp, bias):
+            orig(
+                self, in_c, out_c, ks, st, pad, dil, tr, outp, grp, bias,
+                padding_mode="zeros",
+            )
+
+        _ConvNd.__init__ = patched
+        try:
+            return REF_MODEL.NeighConsensus(
+                use_cuda=False,
+                kernel_sizes=list(ksizes),
+                channels=list(channels),
+                symmetric_mode=True,
+            )
+        finally:
+            _ConvNd.__init__ = orig
+
+
+def test_neigh_consensus_vs_reference_module():
+    """Our symmetric NC stack vs the reference's NeighConsensus module,
+    weights converted from its own (pre-permuted) state dict."""
+    from ncnet_tpu.models.neigh_consensus import neigh_consensus_apply
+    from ncnet_tpu.utils.convert_torch import convert_neigh_consensus
+
+    torch.manual_seed(0)
+    net = _ref_neigh_consensus((5, 5), (6, 1), seed=0)
+    sd = {k: v.detach() for k, v in net.state_dict().items()}
+    params = convert_neigh_consensus(sd, prefix="conv.")
+
+    rng = np.random.RandomState(1)
+    corr = rng.randn(2, 5, 5, 5, 5).astype(np.float32)
+
+    with torch.no_grad():
+        want = net(_t(corr)[:, None]).numpy()[:, 0]
+    got = np.asarray(neigh_consensus_apply(params, jnp.asarray(corr)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------- elementwise model pieces
+
+
+def test_feature_l2norm_vs_reference():
+    from ncnet_tpu.ops.norm import feature_l2norm
+
+    rng = np.random.RandomState(2)
+    f = rng.randn(2, 8, 4, 5).astype(np.float32)  # [b,c,h,w]
+    with torch.no_grad():
+        want = REF_MODEL.featureL2Norm(_t(f)).numpy()
+    got = np.asarray(feature_l2norm(jnp.asarray(f.transpose(0, 2, 3, 1))))
+    np.testing.assert_allclose(got.transpose(0, 3, 1, 2), want, rtol=1e-5, atol=1e-6)
+
+
+def test_mutual_matching_vs_reference():
+    from ncnet_tpu.ops.matching import mutual_matching
+
+    rng = np.random.RandomState(3)
+    corr = rng.rand(2, 4, 5, 6, 3).astype(np.float32)
+    with torch.no_grad():
+        want = REF_MODEL.MutualMatching(_t(corr)[:, None]).numpy()[:, 0]
+    got = np.asarray(mutual_matching(jnp.asarray(corr)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7)
+
+
+def test_correlation_4d_vs_reference():
+    from ncnet_tpu.ops.correlation import correlation_4d
+
+    corr_layer = REF_MODEL.FeatureCorrelation(shape="4D", normalization=False)
+    rng = np.random.RandomState(4)
+    fa = rng.randn(2, 7, 4, 5).astype(np.float32)  # [b,c,hA,wA]
+    fb = rng.randn(2, 7, 3, 6).astype(np.float32)
+    with torch.no_grad():
+        want = corr_layer(_t(fa), _t(fb)).numpy()[:, 0]
+    got = np.asarray(
+        correlation_4d(
+            jnp.asarray(fa.transpose(0, 2, 3, 1)),
+            jnp.asarray(fb.transpose(0, 2, 3, 1)),
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_maxpool4d_vs_reference():
+    """Pooled values AND the decoded per-dim argmax offsets
+    (lib/model.py:177-191)."""
+    from ncnet_tpu.ops.matching import maxpool4d
+
+    rng = np.random.RandomState(5)
+    corr = rng.randn(1, 8, 6, 4, 6).astype(np.float32)
+    k = 2
+    with torch.no_grad():
+        want, wi, wj, wk, wl = REF_MODEL.maxpool4d(_t(corr)[:, None], k_size=k)
+    pooled, (di, dj, dk, dl) = maxpool4d(jnp.asarray(corr), k)
+    np.testing.assert_allclose(np.asarray(pooled), want.numpy()[:, 0], rtol=1e-6)
+    for g, w in zip((di, dj, dk, dl), (wi, wj, wk, wl)):
+        np.testing.assert_array_equal(
+            np.asarray(g), w.numpy()[:, 0].astype(np.int32)
+        )
+
+
+def test_fused_correlation_maxpool4d_vs_reference():
+    """The fused correlate+pool (which never materializes the pre-pool
+    tensor) vs the reference's explicit correlation -> maxpool4d."""
+    from ncnet_tpu.ops.correlation import correlation_maxpool4d
+
+    corr_layer = REF_MODEL.FeatureCorrelation(shape="4D", normalization=False)
+    rng = np.random.RandomState(6)
+    fa = rng.randn(1, 5, 6, 4).astype(np.float32)
+    fb = rng.randn(1, 5, 4, 6).astype(np.float32)
+    k = 2
+    with torch.no_grad():
+        corr = corr_layer(_t(fa), _t(fb))
+        want, wi, wj, wk, wl = REF_MODEL.maxpool4d(corr, k_size=k)
+    pooled, (di, dj, dk, dl) = correlation_maxpool4d(
+        jnp.asarray(fa.transpose(0, 2, 3, 1)),
+        jnp.asarray(fb.transpose(0, 2, 3, 1)),
+        k,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pooled), want.numpy()[:, 0], rtol=1e-4, atol=1e-5
+    )
+    for g, w in zip((di, dj, dk, dl), (wi, wj, wk, wl)):
+        np.testing.assert_array_equal(
+            np.asarray(g), w.numpy()[:, 0].astype(np.int32)
+        )
+
+
+# ---------------------------------------------------------------- readout
+
+
+@pytest.mark.parametrize("invert", [False, True])
+@pytest.mark.parametrize("do_softmax", [False, True])
+@pytest.mark.parametrize("scale", ["centered", "positive"])
+def test_corr_to_matches_vs_reference(invert, do_softmax, scale):
+    """Batch 1: the reference's coordinate gathers `.view(-1)` an expanded
+    tensor, which modern torch rejects for batch > 1 (and the reference
+    eval scripts only ever call this at batch 1); our batch-correct
+    behavior is covered by tests/test_matches.py."""
+    from ncnet_tpu.ops.matches import corr_to_matches
+
+    rng = np.random.RandomState(7)
+    corr = rng.randn(1, 4, 5, 3, 6).astype(np.float32)
+    with torch.no_grad():
+        want = REF_TNF.corr_to_matches(
+            _t(corr)[:, None],
+            do_softmax=do_softmax,
+            scale=scale,
+            invert_matching_direction=invert,
+        )
+    got = corr_to_matches(
+        jnp.asarray(corr),
+        do_softmax=do_softmax,
+        scale=scale,
+        invert_matching_direction=invert,
+    )
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(
+            np.asarray(g), w.numpy(), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_corr_to_matches_relocalization_vs_reference():
+    """The k_size=2 delta4d readout path (eval_inloc configuration;
+    reference delta gather assumes batch 1, lib/point_tnf.py:63-70)."""
+    from ncnet_tpu.ops.matches import corr_to_matches
+    from ncnet_tpu.ops.matching import maxpool4d
+
+    rng = np.random.RandomState(8)
+    corr_hres = rng.randn(1, 8, 6, 4, 6).astype(np.float32)
+    k = 2
+    with torch.no_grad():
+        pooled_t, wi, wj, wk, wl = REF_MODEL.maxpool4d(
+            _t(corr_hres)[:, None], k_size=k
+        )
+        # torch 0.3's integer .div returned longs; torch 2 returns floats —
+        # cast back so the reference's own index arithmetic works unchanged
+        want = REF_TNF.corr_to_matches(
+            pooled_t,
+            delta4d=tuple(d.long() for d in (wi, wj, wk, wl)),
+            k_size=k,
+            do_softmax=True,
+            scale="positive",
+        )
+    pooled, deltas = maxpool4d(jnp.asarray(corr_hres), k)
+    got = corr_to_matches(
+        pooled, delta4d=deltas, k_size=k, do_softmax=True, scale="positive"
+    )
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(
+            np.asarray(g), w.numpy(), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_point_transfer_and_coords_vs_reference():
+    """bilinearInterpPointTnf + nearestNeighPointTnf + the 1-indexed
+    pixel<->unit coordinate transforms (lib/point_tnf.py:82-167)."""
+    from ncnet_tpu.ops.coords import points_to_pixel_coords, points_to_unit_coords
+    from ncnet_tpu.ops.matches import (
+        bilinear_point_transfer,
+        corr_to_matches,
+        nearest_point_transfer,
+    )
+
+    rng = np.random.RandomState(9)
+    corr = rng.randn(1, 5, 5, 5, 5).astype(np.float32)
+    pts = (rng.rand(1, 2, 7) * 1.6 - 0.8).astype(np.float32)
+    im_size = np.array([[240.0, 320.0]], np.float32)
+
+    with torch.no_grad():
+        wm = REF_TNF.corr_to_matches(_t(corr)[:, None], do_softmax=True)
+        want_bil = REF_TNF.bilinearInterpPointTnf(wm[:4], _t(pts)).numpy()
+        want_nn = REF_TNF.nearestNeighPointTnf(wm[:4], _t(pts)).numpy()
+        want_px = REF_TNF.PointsToPixelCoords(_t(pts), _t(im_size)).numpy()
+        want_un = REF_TNF.PointsToUnitCoords(
+            _t(want_px.copy()), _t(im_size)
+        ).numpy()
+
+    gm = corr_to_matches(jnp.asarray(corr), do_softmax=True)
+    got_bil = np.asarray(bilinear_point_transfer(gm[:4], jnp.asarray(pts)))
+    got_nn = np.asarray(nearest_point_transfer(gm[:4], jnp.asarray(pts)))
+    got_px = np.asarray(
+        points_to_pixel_coords(jnp.asarray(pts), jnp.asarray(im_size))
+    )
+    got_un = np.asarray(
+        points_to_unit_coords(jnp.asarray(got_px), jnp.asarray(im_size))
+    )
+    np.testing.assert_allclose(got_bil, want_bil, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got_nn, want_nn, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got_px, want_px, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(got_un, want_un, rtol=1e-5, atol=1e-6)
+
+
+def test_pck_vs_reference():
+    """Reference pck slices the first N contiguous valid columns; ours
+    masks — equivalent because padding is trailing (lib/eval_util.py:12-24)."""
+    from ncnet_tpu.ops.metrics import pck
+
+    rng = np.random.RandomState(10)
+    src = rng.rand(3, 2, 8).astype(np.float32) * 200
+    src[0, :, 6:] = -1  # trailing -1 padding
+    src[2, :, 3:] = -1
+    warped = src + rng.randn(3, 2, 8).astype(np.float32) * 15
+    l_pck = np.array([150.0, 80.0, 220.0], np.float32)
+
+    with torch.no_grad():
+        want = REF_EVAL.pck(_t(src), _t(warped), _t(l_pck)).numpy()
+    got = np.asarray(pck(jnp.asarray(src), jnp.asarray(warped), jnp.asarray(l_pck)))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+# ------------------------------------------------------------ whole chains
+
+
+def test_full_chain_corr_to_pck_vs_reference():
+    """corr -> MM -> NC -> MM -> softmax readout -> bilinear transfer ->
+    pixel coords -> PCK: the reference's entire post-backbone eval chain
+    (lib/model.py:261-282 + eval_pf_pascal.py:69-81) on identical weights."""
+    from ncnet_tpu.models.immatchnet import ImMatchNetConfig, match_pipeline
+    from ncnet_tpu.ops.coords import points_to_pixel_coords, points_to_unit_coords
+    from ncnet_tpu.ops.matches import bilinear_point_transfer, corr_to_matches
+    from ncnet_tpu.ops.metrics import pck
+    from ncnet_tpu.ops.norm import feature_l2norm
+    from ncnet_tpu.utils.convert_torch import convert_neigh_consensus
+
+    torch.manual_seed(11)
+    net = _ref_neigh_consensus((3, 3), (8, 1), seed=11)
+    sd = {k: v.detach() for k, v in net.state_dict().items()}
+    nc_params = convert_neigh_consensus(sd, prefix="conv.")
+    corr_layer = REF_MODEL.FeatureCorrelation(shape="4D", normalization=False)
+
+    rng = np.random.RandomState(11)
+    fa = rng.randn(1, 16, 6, 6).astype(np.float32)  # [b,c,h,w]
+    fb = rng.randn(1, 16, 6, 6).astype(np.float32)
+    tgt_pts = (rng.rand(1, 2, 9) * 150 + 20).astype(np.float32)
+    src_pts = (rng.rand(1, 2, 9) * 150 + 20).astype(np.float32)
+    im_size = np.array([[200.0, 180.0]], np.float32)
+    l_pck = np.array([120.0], np.float32)
+
+    with torch.no_grad():
+        tfa = REF_MODEL.featureL2Norm(_t(fa))
+        tfb = REF_MODEL.featureL2Norm(_t(fb))
+        corr = corr_layer(tfa, tfb)
+        corr = REF_MODEL.MutualMatching(corr)
+        corr = net(corr)
+        corr = REF_MODEL.MutualMatching(corr)
+        wm = REF_TNF.corr_to_matches(corr, do_softmax=True)
+        tp_norm = REF_TNF.PointsToUnitCoords(_t(tgt_pts), _t(im_size))
+        warped_norm = REF_TNF.bilinearInterpPointTnf(wm[:4], tp_norm)
+        warped = REF_TNF.PointsToPixelCoords(warped_norm, _t(im_size))
+        want_pck = REF_EVAL.pck(_t(src_pts), warped, _t(l_pck)).numpy()
+        want_corr = corr.numpy()[:, 0]
+
+    config = ImMatchNetConfig(ncons_kernel_sizes=(3, 3), ncons_channels=(8, 1))
+    jfa = feature_l2norm(jnp.asarray(fa.transpose(0, 2, 3, 1)))
+    jfb = feature_l2norm(jnp.asarray(fb.transpose(0, 2, 3, 1)))
+    got_corr = match_pipeline(nc_params, config, jfa, jfb)
+    gm = corr_to_matches(got_corr, do_softmax=True)
+    jp_norm = points_to_unit_coords(jnp.asarray(tgt_pts), jnp.asarray(im_size))
+    warped_norm_j = bilinear_point_transfer(gm[:4], jp_norm)
+    warped_j = points_to_pixel_coords(warped_norm_j, jnp.asarray(im_size))
+    got_pck = np.asarray(
+        pck(jnp.asarray(src_pts), warped_j, jnp.asarray(l_pck))
+    )
+
+    np.testing.assert_allclose(
+        np.asarray(got_corr), want_corr, rtol=1e-4, atol=1e-6
+    )
+    np.testing.assert_allclose(got_pck, want_pck, rtol=1e-6)
+
+
+def test_weak_loss_vs_reference():
+    """The reference's own ``weak_loss`` source (extracted from train.py,
+    incl. its in-place source-batch roll) vs our functional loss math, with
+    the backbone factored out: both sides consume the same L2-normalized
+    feature maps through the same NC weights."""
+    from ncnet_tpu.models.immatchnet import ImMatchNetConfig, match_pipeline
+    from ncnet_tpu.train.loss import match_score
+    from ncnet_tpu.utils.convert_torch import convert_neigh_consensus
+
+    weak_loss_ref = _extract_weak_loss()
+
+    torch.manual_seed(12)
+    net = _ref_neigh_consensus((3, 3), (8, 1), seed=12)
+    sd = {k: v.detach() for k, v in net.state_dict().items()}
+    nc_params = convert_neigh_consensus(sd, prefix="conv.")
+    corr_layer = REF_MODEL.FeatureCorrelation(shape="4D", normalization=False)
+
+    rng = np.random.RandomState(12)
+    b = 4
+    fa = rng.randn(b, 16, 6, 6).astype(np.float32)
+    fb = rng.randn(b, 16, 6, 6).astype(np.float32)
+
+    class StubModel:
+        """Reference ImMatchNet.forward with the trunk replaced by identity:
+        batch['source_image'] / ['target_image'] ARE the feature maps."""
+
+        def __call__(self, batch):
+            with torch.no_grad():
+                sfa = REF_MODEL.featureL2Norm(batch["source_image"])
+                sfb = REF_MODEL.featureL2Norm(batch["target_image"])
+                corr = corr_layer(sfa, sfb)
+                corr = REF_MODEL.MutualMatching(corr)
+                corr = net(corr)
+                return REF_MODEL.MutualMatching(corr)
+
+    batch = {"source_image": _t(fa.copy()), "target_image": _t(fb.copy())}
+    want = float(weak_loss_ref(StubModel(), batch, normalization="softmax"))
+
+    config = ImMatchNetConfig(ncons_kernel_sizes=(3, 3), ncons_channels=(8, 1))
+    from ncnet_tpu.ops.norm import feature_l2norm
+
+    jfa = feature_l2norm(jnp.asarray(fa.transpose(0, 2, 3, 1)))
+    jfb = feature_l2norm(jnp.asarray(fb.transpose(0, 2, 3, 1)))
+    jfa_neg = jnp.roll(jfa, -1, axis=0)  # train.py:137's np.roll pairing
+    corr_pos = match_pipeline(nc_params, config, jfa, jfb)
+    corr_neg = match_pipeline(nc_params, config, jfa_neg, jfb)
+    got = float(match_score(corr_neg) - match_score(corr_pos))
+
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
