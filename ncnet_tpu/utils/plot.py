@@ -52,6 +52,24 @@ def save_plot(filename, fig=None, dpi=150):
     fig.savefig(filename, dpi=dpi, bbox_inches="tight", pad_inches=0.05)
 
 
+def plot_localization_curve(thresholds_m, rate_percent, label="ncnet_tpu"):
+    """Localization-rate curve figure — % correctly localized queries vs
+    distance threshold, the reference's final InLoc deliverable
+    (ht_plotcurve_WUSTL.m:95-111, axes/ticks matched; PNG instead of
+    .fig/.eps)."""
+    plt = _plt()
+    fig, ax = plt.subplots(figsize=(5, 5))
+    ax.plot(thresholds_m, rate_percent, "-", linewidth=2, label=label)
+    ax.set_xlabel("Distance threshold [meters]")
+    ax.set_ylabel("Correctly localized queries [%]")
+    ax.set_xticks(np.arange(0, 2.0 + 1e-9, 0.25))
+    ax.set_xlim(0, 2.0)
+    ax.set_ylim(0, 100)
+    ax.grid(True, alpha=0.3)
+    ax.legend(loc="lower right", fontsize=10)
+    return fig
+
+
 def draw_point_transfer(
     source_image,
     target_image,

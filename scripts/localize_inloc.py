@@ -104,6 +104,9 @@ def main():
                         "<scan_dir>/<floor>/<scene>_scan_<scan>.mat "
                         "(cell array A: columns X Y Z _ R G B)")
     p.add_argument("--out", default="localization.json")
+    p.add_argument("--method", default="ncnet_tpu",
+                   help="method label used in the persisted artifact names "
+                        "(error_<method>.txt, curve_<method>.png)")
     args = p.parse_args()
     if args.densePV and not args.scan_dir:
         p.error("--densePV requires --scan_dir")
@@ -219,7 +222,7 @@ def main():
 
     if args.refposes:
         gt = loadmat(args.refposes, squeeze_me=True)
-        pos_err, ori_err = [], []
+        names, pos_err, ori_err = [], [], []
         for list_name, floor in (("DUC1_RefList", "DUC1"),
                                  ("DUC2_RefList", "DUC2")):
             for rec in np.atleast_1d(gt[list_name]):
@@ -239,11 +242,30 @@ def main():
                     )
                 else:
                     dp, do = np.inf, np.inf
+                names.append(qname)
                 pos_err.append(dp)
                 ori_err.append(do)
         thr, rate = localization_rate_curve(pos_err, ori_err)
         for t, r in zip(thr, rate):
             print(f"  {t:6.4f} m : {r:5.1f} %")
+
+        # Persist the benchmark's deliverables next to --out, in the
+        # spirit of ht_plotcurve_WUSTL.m: a per-query error file
+        # (error_<method>.txt, ':15,36,65' — "<queryname> <pos> <ori>"
+        # lines, orientation in degrees like max_orierr) and the
+        # localization-rate curve figure (':107-111', PNG instead of
+        # .fig/.eps).
+        out_dir = os.path.dirname(os.path.abspath(args.out))
+        err_path = os.path.join(out_dir, f"error_{args.method}.txt")
+        with open(err_path, "w") as f:
+            for qname, dp, do in zip(names, pos_err, ori_err):
+                f.write(f"{qname} {dp:f} {np.rad2deg(do):f}\n")
+        from ncnet_tpu.utils.plot import plot_localization_curve, save_plot
+
+        fig = plot_localization_curve(thr, rate, label=args.method)
+        curve_path = os.path.join(out_dir, f"curve_{args.method}.png")
+        save_plot(curve_path, fig=fig)
+        print(f"wrote {err_path} and {curve_path}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,127 @@
+"""End-to-end test of scripts/localize_inloc.py on synthetic fixtures,
+including the persisted eval artifacts (per-query error file + rate-curve
+figure — the reference's ht_plotcurve_WUSTL.m deliverables)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+pytest.importorskip("scipy")
+pytest.importorskip("PIL")
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _rot(rng):
+    Q, _ = np.linalg.qr(rng.randn(3, 3))
+    if np.linalg.det(Q) < 0:
+        Q[:, 0] *= -1
+    return Q
+
+
+def test_localize_cli_writes_json_errors_and_curve(tmp_path):
+    from PIL import Image
+    from scipy.io import savemat
+
+    rng = np.random.RandomState(7)
+    dh, dw = 60, 80
+    qh, qw = 48, 64
+    fl = 50.0
+
+    # synthetic RGBD cutout surface in GLOBAL coords (no alignment file)
+    gy, gx = np.mgrid[0:dh, 0:dw]
+    xyz = np.stack(
+        [gx * 0.05, gy * 0.05, 3.0 + 0.3 * np.sin(gx * 0.1)], axis=-1
+    )
+    # ground-truth query pose
+    R = _rot(rng)
+    t = rng.randn(3) * 0.1 + np.array([1.5, 1.0, 1.0])
+    P_gt = np.concatenate([R, t[:, None]], axis=1)
+
+    n = 120
+    px = rng.randint(1, dw + 1, n)
+    py = rng.randint(1, dh + 1, n)
+    X = xyz[py - 1, px - 1]
+    Xc = X @ R.T + t
+    xq = Xc[:, 0] / Xc[:, 2] * fl + qw / 2.0
+    yq = Xc[:, 1] / Xc[:, 2] * fl + qh / 2.0
+    matches_rows = np.stack(
+        [xq / qw, yq / qh, (px + 0.5) / dw, (py + 0.5) / dh, np.full(n, 0.9)],
+        axis=1,
+    )
+
+    # fixture layout
+    (tmp_path / "query").mkdir()
+    Image.fromarray(rng.randint(0, 255, (qh, qw, 3), np.uint8)).save(
+        tmp_path / "query" / "q0.png"
+    )
+    cutdir = tmp_path / "cutouts" / "DUC1"
+    cutdir.mkdir(parents=True)
+    savemat(cutdir / "p0.jpg.mat", {"XYZcut": xyz})
+    mdir = tmp_path / "matches"
+    mdir.mkdir()
+    savemat(mdir / "1.mat", {"matches": matches_rows[None, None]})
+
+    dt = np.dtype([("queryname", object), ("topN", object)])
+    entry = np.zeros((1, 1), dt)
+    entry[0, 0] = (
+        np.array(["q0.png"], object),
+        np.array([["DUC1/p0.jpg"]], object),
+    )
+    savemat(tmp_path / "shortlist.mat", {"ImgList": entry})
+
+    ref_dt = np.dtype([("queryname", object), ("P", object)])
+    duc1 = np.zeros((1, 1), ref_dt)
+    duc1[0, 0] = (np.array(["q0.png"], object), P_gt)
+    duc2 = np.zeros((1, 1), ref_dt)
+    duc2[0, 0] = (  # a query with no result -> inf errors path
+        np.array(["missing.png"], object),
+        np.concatenate([np.eye(3), np.zeros((3, 1))], axis=1),
+    )
+    savemat(
+        tmp_path / "refposes.mat",
+        {"DUC1_RefList": duc1, "DUC2_RefList": duc2},
+    )
+
+    out_json = tmp_path / "localization.json"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            str(REPO / "scripts" / "localize_inloc.py"),
+            "--matches_dir", str(mdir),
+            "--shortlist", str(tmp_path / "shortlist.mat"),
+            "--cutout_dir", str(tmp_path / "cutouts"),
+            "--query_dir", str(tmp_path / "query"),
+            "--focal", str(fl),
+            "--n_queries", "1",
+            "--n_panos", "1",
+            "--refposes", str(tmp_path / "refposes.mat"),
+            "--out", str(out_json),
+            "--method", "testm",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+
+    results = json.loads(out_json.read_text())
+    assert results[0]["queryname"] == "q0.png"
+    assert results[0]["P"][0] is not None
+
+    err_lines = (tmp_path / "error_testm.txt").read_text().splitlines()
+    assert len(err_lines) == 2
+    q0 = err_lines[0].split()
+    assert q0[0] == "q0.png"
+    assert float(q0[1]) < 0.05  # position error, meters
+    assert float(q0[2]) < 1.0  # orientation error, degrees
+    missing = err_lines[1].split()
+    assert missing[0] == "missing.png"
+    assert missing[1] == "inf"
+
+    curve = tmp_path / "curve_testm.png"
+    assert curve.exists() and curve.stat().st_size > 1000

@@ -27,6 +27,12 @@ torch = pytest.importorskip("torch")
 
 REF_ROOT = "/root/reference"
 
+if not __import__("os").path.isdir(f"{REF_ROOT}/lib"):
+    pytest.skip(
+        f"reference checkout not present at {REF_ROOT}",
+        allow_module_level=True,
+    )
+
 # All conv4d lowerings that run on the CPU test platform.
 CONV4D_IMPLS = [
     "xla", "taps", "scan", "tlc", "btl", "tlcv", "tf3", "tf2",
@@ -108,11 +114,13 @@ def test_conv4d_vs_reference_loop(impl):
 
 
 def _ref_neigh_consensus(ksizes, channels, seed):
-    """Instantiate the reference NeighConsensus on CPU; returns the module.
+    """Instantiate the reference NeighConsensus on CPU with seeded weight
+    init; returns the module.
 
     torch >= 1.x added a required ``padding_mode`` arg to ``_ConvNd`` that
     the 0.3-era reference doesn't pass; shim it for the construction only.
     """
+    torch.manual_seed(seed)
     try:
         return REF_MODEL.NeighConsensus(
             use_cuda=False,
@@ -149,7 +157,6 @@ def test_neigh_consensus_vs_reference_module():
     from ncnet_tpu.models.neigh_consensus import neigh_consensus_apply
     from ncnet_tpu.utils.convert_torch import convert_neigh_consensus
 
-    torch.manual_seed(0)
     net = _ref_neigh_consensus((5, 5), (6, 1), seed=0)
     sd = {k: v.detach() for k, v in net.state_dict().items()}
     params = convert_neigh_consensus(sd, prefix="conv.")
@@ -388,7 +395,6 @@ def test_full_chain_corr_to_pck_vs_reference():
     from ncnet_tpu.ops.norm import feature_l2norm
     from ncnet_tpu.utils.convert_torch import convert_neigh_consensus
 
-    torch.manual_seed(11)
     net = _ref_neigh_consensus((3, 3), (8, 1), seed=11)
     sd = {k: v.detach() for k, v in net.state_dict().items()}
     nc_params = convert_neigh_consensus(sd, prefix="conv.")
@@ -445,7 +451,6 @@ def test_weak_loss_vs_reference():
 
     weak_loss_ref = _extract_weak_loss()
 
-    torch.manual_seed(12)
     net = _ref_neigh_consensus((3, 3), (8, 1), seed=12)
     sd = {k: v.detach() for k, v in net.state_dict().items()}
     nc_params = convert_neigh_consensus(sd, prefix="conv.")
