@@ -13,19 +13,35 @@ peak so absurd numbers are self-evident: analytic FLOPs per step are
 derived from the config below (the 25^4 x 5^4 NC convolutions dominate:
 conv2 alone is ~125 GFLOP/pair/direction).
 
-Measured formulation ceiling (round 2, v5e): the NC convolutions cap at
-~20-30 TFLOP/s f+b across every lowering tried (direct rank-4, tap sums,
-channel-fused conv2d 'cf'/'cfs', im2col GEMM, Toeplitz 'tlc'); only
-5x-FLOP-inflated wide-lane forms reach >130 TFLOP/s hardware rate, netting
-~26 useful — the 16-channel, 25-grid shapes are the binding constraint.
-Best known config (11.9 pairs/s, 10.4% MFU): tlc + loss_chunk 8 + chunk
-remat with the 'nc_conv' save-policy (convs not recomputed in backward) —
-tlc's 5x-inflated wide-lane forward wins end-to-end once the policy stops
-the backward from re-running forwards; cfs + chunk 4 = 10.5. The blocked
-Toeplitz 'btl' (3.1x inflation, 192/128 lanes) measures 11.0 at chunk 4 —
-the per-block window gather costs what the FLOP reduction saves. 'tlcv'
-(tlc forward + custom-VJP true-FLOP rank-4 kernel gradient) measures 6.5:
-the rank-4 dw is slower than the 5x-inflated Toeplitz dw it replaces.
+Measured formulation ceiling (rounds 2-3, v5e). Round-3 calibrations: a
+plain [M, 400] @ [400, 400] GEMM sustains ~200 TFLOP/s on this chip and
+the tlc conv3d runs at 137 TFLOP/s hardware — the MXU is NOT the limit;
+XLA's data movement is. Three layout findings drive everything:
+  (1) 6D/7D intermediates draw pathological XLA layouts on TPU (4-10x
+      tile padding, measured OOMs) — every gather/epilogue must stay <=5D
+      with the natural minor dim (the round-3 rewrites of cf/btl/tf2);
+  (2) slice-sum epilogues do not fuse (each term re-reads the padded
+      tensor), so tap foldings whose conv output is kj*kk/cout times the
+      activation ('cf1': conv1d core measured 84 TFLOP/s true!) lose it
+      all to a 25-term epilogue over a 5 GB tensor;
+  (3) buffers saved across the loss-chunk lax.map loop get
+      layout-pessimized (5.1x pad), so only the compact packed 'nc_conv'
+      outputs are worth saving.
+A Pallas kernel cannot beat this either: Mosaic requires 8-aligned
+sublane offsets, but conv4d row shifts have granularity 1 in the fused
+(j,k) dims, forcing the same banded/inflated formulations (>=3.2x
+effective with K/N pads) that XLA already runs at 70% peak.
+Best known config (15.86 pairs/s, 13.9% MFU): PER-LAYER impl mixing
+'tlc,btl4,tlc' + loss_chunk 8 + 'nc_conv' save-policy remat. The middle
+16->16 layer (89% of stack FLOPs) uses the 5D-safe blocked Toeplitz at
+block 4 (1.79x inflation, the measured sweet spot: block 2 = 14.0
+pairs/s end-to-end, block 5 = 14.0, block 8 = 14.6, dense 'tlc' = 11.9);
+the 1-channel edge layers keep the dense Toeplitz ('tlc'). 'tf2' on the
+16->1 layer wins in isolation (8.4 vs 27.4 ms/pass) but loses end-to-end
+under the remat loop (13.6). Batch 32 changes nothing (15.9 — per-pair
+cost is flat). Negative results kept as impls for the record: 'cf1'
+(epilogue-bound), 'cf1s'/'ck1'/'tk1' (scan kills fusion / 6D gathers),
+'tlcv' (true-FLOP dw slower than the inflated one it replaces).
 
 Baseline: the reference repo publishes no throughput numbers (BASELINE.md).
 ``V100_EST_PAIRS_PER_SEC`` is an analytic estimate for the reference
@@ -69,12 +85,16 @@ def train_step_flops(batch, grid=25, feat_ch=1024, image=400):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--conv4d_impl", default="tlc")
+    p.add_argument("--conv4d_impl", default="tlc,btl4,tlc",
+                   help="one impl or a comma-separated per-NC-layer list")
     p.add_argument("--nc_remat", action="store_true")
     p.add_argument("--no_chunk_remat", action="store_true",
                    help="disable per-chunk rematerialization (needs the "
                         "packed-layout residuals to fit in HBM)")
     p.add_argument("--loss_chunk", type=int, default=8)
+    p.add_argument("--sym_seq", action="store_true",
+                   help="run the symmetric NC passes sequentially instead "
+                        "of double-batched (halves stack live memory)")
     p.add_argument("--batch", type=int, default=16)
     p.add_argument("--steps", type=int, default=10)
     args = p.parse_args()
@@ -98,6 +118,7 @@ def main():
         nc_remat=args.nc_remat,
         loss_chunk=args.loss_chunk,
         loss_chunk_remat=not args.no_chunk_remat,
+        symmetric_batch=not args.sym_seq,
     )
     params = init_immatchnet(jax.random.PRNGKey(0), config)
     optimizer = make_optimizer()

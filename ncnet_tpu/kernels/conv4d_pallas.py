@@ -20,16 +20,28 @@ spatially-flipped, channel-transposed filters (a 4D convolution identity);
 dw runs a second kernel that contracts the same patches against the
 incoming cotangent per tap-triple.
 
-STATUS (round 2, measured on v5e): the kernel is numerically verified in
-interpret mode (forward + full VJP, tests/test_conv4d.py) but does NOT
-lower through Mosaic on current libtpu — the in-kernel ``[J, K*L*C] ->
-[J, K, L, C]`` reshape fails layout inference ("unsupported shape cast").
-More importantly, the design cannot win on this op: with <=16 output
-channels every patch-GEMM formulation is capped at 16/128 MXU lanes, and
-the lane-widening tap-folding tricks (`ops.conv4d` impls 'cf'/'cfs', 20-30
-TFLOP/s measured f+b) are exactly what XLA's conv already compiles well.
-Kept as the interpret-verified scaffold for a future kernel where fusion
-wins (e.g. conv4d+ReLU+MutualMatching in one pass).
+STATUS (rounds 2-3, measured on v5e): the kernel is numerically verified
+in interpret mode (forward + full VJP, tests/test_conv4d.py) but does NOT
+lower through Mosaic — re-confirmed on round 3's libtpu: the in-kernel
+``[J, K*L*C] -> [J, K, L, C]`` reshape still fails layout inference
+("unsupported shape cast", vector<1x8x1024> -> vector<8x8x8x16>).
+
+Round 3 closed the question of whether a redesigned kernel could win:
+  * the MXU itself is fast at these dims (a [M, 400] @ [400, 400] GEMM
+    sustains ~200 TFLOP/s; XLA's tlc conv3d runs at 137 = 70% of peak),
+    so the prize would be feeding it un-inflated patches from VMEM;
+  * but Mosaic requires sublane (row) offsets provably 8-aligned, and
+    conv4d's tap shifts have granularity 1 row in any fused-rows layout
+    ((i,j,k) fused: dk shifts by 1; (i,j): dj by 1). Padding the fused
+    dims to 8-multiples (J, K -> 32) costs 1.64x, the l-band costs
+    12/5 = 2.4x, and K-dim tile pads 1.33x — >=5x effective inflation,
+    i.e. no better than the banded formulations XLA already compiles at
+    70% peak (`ops.conv4d` 'tlc'/'btl4');
+  * a VMEM-budget-accurate probe of the banded inner loop additionally
+    hit the 16 MB scoped-vmem wall at useful tile sizes.
+The production answer is per-layer impl mixing in XLA ('tlc,btl4,tlc' —
+see bench.py). Kept as the interpret-verified scaffold and the record of
+WHY a hand kernel loses on this op/hardware pair.
 """
 
 import functools

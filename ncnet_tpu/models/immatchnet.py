@@ -44,6 +44,10 @@ class ImMatchNetConfig:
     half_precision: bool = False  # bf16 feature/correlation path (TPU-native fp16)
     conv4d_impl: str = "xla"
     nc_remat: bool = False  # rematerialize each NC layer in the backward pass
+    # Run the symmetric NC passes as one double-batch net application
+    # (True, reference-equivalent math either way) or sequentially (False
+    # — halves the stack's live batch for memory-heavy conv4d impls).
+    symmetric_batch: bool = True
     # Run the correlation->NC->score pipeline over sample chunks of this
     # size in the training loss (0 = whole batch): bounds the live 4D
     # tensors to the chunk, enabling the wide-lane conv4d impls at batch 16.
@@ -112,6 +116,7 @@ def match_pipeline(nc_params, config: ImMatchNetConfig, feat_a, feat_b):
         symmetric=config.symmetric_mode,
         impl=config.conv4d_impl,
         remat=config.nc_remat,
+        symmetric_batch=config.symmetric_batch,
     )
     corr = mutual_matching(corr).astype(jnp.float32)
     if k > 1:

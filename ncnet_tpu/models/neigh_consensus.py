@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
-from ncnet_tpu.ops.conv4d import conv4d_packed
+from ncnet_tpu.ops.conv4d import conv4d_packed, resolve_layer_impls
 
 
 def init_neigh_consensus(rng, kernel_sizes=(3, 3, 3), channels=(10, 10, 1)):
@@ -63,14 +63,18 @@ def _unpack(x, k, l):
     return x.reshape(b, i, j, k, l, fused // (k * l))
 
 
-def neigh_consensus_apply(params, corr, symmetric=True, impl="xla", remat=False):
+def neigh_consensus_apply(params, corr, symmetric=True, impl="xla", remat=False,
+                          symmetric_batch=True):
     """Filter a correlation tensor.
 
     Args:
       params: from `init_neigh_consensus`.
       corr: ``[b, iA, jA, iB, jB]`` (no channel axis).
       symmetric: reference ``symmetric_mode`` (default True).
-      impl: conv4d implementation (see `ops.conv4d.conv4d`).
+      impl: conv4d implementation (see `ops.conv4d.conv4d`), either one
+        name for all layers or a comma-separated per-layer list (e.g.
+        ``'tlc,cf1,tlc'`` — the layers have very different channel shapes,
+        and the measured-best formulation differs per layer).
       remat: additionally rematerialize each layer in the backward pass
         (saves the inter-layer activations' backward residuals at the cost
         of re-running each layer's forward).
@@ -92,7 +96,9 @@ def neigh_consensus_apply(params, corr, symmetric=True, impl="xla", remat=False)
 
     dtype = corr.dtype
 
-    def packed_layer(xp, p, kl):
+    layer_impls = resolve_layer_impls(impl, len(params))
+
+    def packed_layer(xp, p, kl, layer_impl):
         # params follow the activation dtype (the reference casts NC
         # weights to half in fp16 mode, lib/model.py:253-258)
         y = conv4d_packed(
@@ -100,7 +106,7 @@ def neigh_consensus_apply(params, corr, symmetric=True, impl="xla", remat=False)
             p["kernel"].astype(dtype),
             kl,
             p["bias"].astype(dtype),
-            impl=impl,
+            impl=layer_impl,
         )
         # named for jax.checkpoint save-policies: an outer remat (the loss
         # chunking) can save exactly these conv outputs and recompute only
@@ -109,25 +115,28 @@ def neigh_consensus_apply(params, corr, symmetric=True, impl="xla", remat=False)
         return jax.nn.relu(y)
 
     layer_fn = (
-        jax.checkpoint(packed_layer, static_argnums=(2,)) if remat
+        jax.checkpoint(packed_layer, static_argnums=(2, 3)) if remat
         else packed_layer
     )
 
     def net(x):
         kl = (x.shape[3], x.shape[4])
         xp = _pack(x)
-        for p in params:
-            xp = layer_fn(xp, p, kl)
+        for p, layer_impl in zip(params, layer_impls):
+            xp = layer_fn(xp, p, kl, layer_impl)
         return _unpack(xp, *kl)
 
     x = corr[..., None]
     if symmetric:
         xt = _swap_ab(x)
-        if x.shape == xt.shape:
+        if x.shape == xt.shape and symmetric_batch:
             b = x.shape[0]
             y = net(jnp.concatenate([x, xt], axis=0))
             out = y[:b] + _swap_ab(y[b:])
-        else:  # rectangular A/B grids (eval pairs) can't batch the swap
+        else:  # rectangular A/B grids (eval pairs) can't batch the swap;
+            # symmetric_batch=False runs the passes sequentially on
+            # purpose (halves the stack's live batch for memory-heavy
+            # conv4d impls)
             out = net(x) + _swap_ab(net(xt))
     else:
         out = net(x)

@@ -29,6 +29,27 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# Canonical impl registry: every name `conv4d` dispatches on. 'pallas' is
+# listed separately by callers that can run interpret mode; CLI surfaces
+# exclude it (it does not lower on TPU — kernels/conv4d_pallas.py STATUS).
+CONV4D_IMPLS = (
+    "xla", "taps", "scan", "tlc", "btl", "btl2", "btl4", "btl5", "tlcv",
+    "tf3", "tf2", "cf", "cfs", "cf1", "cf1s", "ck1", "tk1", "gemm", "gemms",
+)
+
+
+def resolve_layer_impls(impl, n_layers):
+    """One impl name or a comma-separated per-layer list -> list of
+    ``n_layers`` names (shared by the unsharded and sharded NC stacks)."""
+    impls = impl.split(",") if isinstance(impl, str) else list(impl)
+    if len(impls) == 1:
+        impls = impls * n_layers
+    if len(impls) != n_layers:
+        raise ValueError(
+            f"conv4d impl list {impls} does not match {n_layers} NC layers"
+        )
+    return impls
+
 
 def _banded_weights(w, n_rows, n_cols, offset):
     """Expand ``w`` into a banded (Toeplitz) channel-mixing matrix over l:
@@ -105,12 +126,19 @@ def _conv4d_btl(x, w, block=8):
     xp = jnp.pad(
         x, ((0, 0),) * 4 + ((pad, lpad - l + pad), (0, 0))
     )  # l axis length lpad + 2*pad
-    # windows: block lb covers padded-l [lb*block, lb*block + window)
-    xw = jnp.stack(
-        [xp[:, :, :, :, lb * block : lb * block + window] for lb in range(nb)],
-        axis=1,
-    )  # [b, nb, i, j, k, window, cin]
-    xw = xw.reshape(b * nb, i, j, k, window * cin)
+    # windows: block lb covers padded-l [lb*block, lb*block + window).
+    # Each window is reshaped to 5D BEFORE the stack: the previous 7D
+    # [b, nb, i, j, k, window, cin] intermediate drew a pathological XLA
+    # layout on TPU (same failure mode as the 6D channel-fused gathers).
+    xw = jnp.concatenate(
+        [
+            xp[:, :, :, :, lb * block : lb * block + window].reshape(
+                b, i, j, k, window * cin
+            )
+            for lb in range(nb)
+        ],
+        axis=0,
+    )  # [nb*b, i, j, k, window*cin] (block-major on the batch axis)
     t = _banded_weights(w, window, block, 0).astype(x.dtype)
     dn = lax.conv_dimension_numbers(
         xw.shape, t.shape, ("NijkC", "ijkIO", "NijkC")
@@ -122,9 +150,14 @@ def _conv4d_btl(x, w, block=8):
         padding="SAME",
         dimension_numbers=dn,
         preferred_element_type=x.dtype,
-    )  # [b*nb, i, j, k, block*cout]
-    y = y.reshape(b, nb, i, j, k, block, cout)
-    y = jnp.moveaxis(y, 1, 4).reshape(b, i, j, k, nb * block, cout)
+    )  # [nb*b, i, j, k, block*cout] (block-major batch, matching xw)
+    # Reassemble l from the batch blocks with 5D ops only: slice each
+    # block back out and concat on the channel axis, giving minor order
+    # (lb, pos, cout) = (l, cout); then one small 6D view to trim l.
+    y = jnp.concatenate(
+        [y[lb * b : (lb + 1) * b] for lb in range(nb)], axis=-1
+    )  # [b, i, j, k, nb*block*cout]
+    y = y.reshape(b, i, j, k, nb * block, cout)
     return y[:, :, :, :, :l]
 
 
@@ -379,6 +412,9 @@ def _conv4d_tapsfused2(x, w):
     dn = lax.conv_dimension_numbers(
         x2.shape, w2.shape, ("NklC", "klIO", "NklC")
     )
+    # epilogue on a 5D view with (k, l) fused — they are never shifted
+    # here, and 6D intermediates draw pathological XLA layouts on TPU
+    # (see the cf/btl notes)
     y = lax.conv_general_dilated(
         x2,
         w2,
@@ -386,17 +422,17 @@ def _conv4d_tapsfused2(x, w):
         padding="SAME",
         dimension_numbers=dn,
         preferred_element_type=x.dtype,
-    ).reshape(b, i, j, k, l, ki * kj * cout)
-    ypad = jnp.pad(y, ((0, 0), (pi, pi), (pj, pj)) + ((0, 0),) * 3)
+    ).reshape(b, i, j, k * l, ki * kj * cout)
+    ypad = jnp.pad(y, ((0, 0), (pi, pi), (pj, pj), (0, 0), (0, 0)))
     out = None
     for di in range(ki):
         for dj in range(kj):
             t = di * kj + dj
             term = ypad[
-                :, di : di + i, dj : dj + j, :, :, t * cout : (t + 1) * cout
+                :, di : di + i, dj : dj + j, :, t * cout : (t + 1) * cout
             ]
             out = term if out is None else out + term
-    return out
+    return out.reshape(b, i, j, k, l, cout)
 
 
 def _cf_kernel(w):
@@ -422,9 +458,24 @@ def _conv4d_cf(x, w):
     ki, kj, kk, kl, _, cout = w.shape
     pi, pj = ki // 2, kj // 2
     xpad = jnp.pad(x, ((0, 0), (pi, pi)) + ((0, 0),) * 4)
-    # [b, i, j, k, l, ki*cin]: channel block di holds x shifted by di-pi in i
-    xs = jnp.concatenate([xpad[:, di : di + i] for di in range(ki)], axis=-1)
-    x2 = xs.reshape(b * i * j, k, l, ki * cin)
+    # [b*i*j, k, l, ki*cin]: channel block di holds x shifted by di-pi in i.
+    # Each slice is reshaped to 4D BEFORE the concat: a 6D gather tensor
+    # (and its 6D split in the backward transpose) gets a pathological
+    # XLA layout on TPU (measured 10.2x tile padding -> OOM at batch 16);
+    # the 4D form keeps the natural [.., k, l, c] layout on both sides.
+    xs = jnp.concatenate(
+        [
+            xpad[:, di : di + i].reshape(b * i * j, k, l, cin)
+            for di in range(ki)
+        ],
+        axis=-1,
+    )
+    # NOT checkpoint-named: saving the gathered patches across the loss-
+    # chunk remat boundary was measured to make things WORSE — buffers
+    # that live across the lax.map while-loop get layout-pessimized by XLA
+    # (5.1x tile padding -> OOM), costing more than the re-gather's
+    # remat-compress copies save.
+    x2 = xs
     w2 = _cf_kernel(w)
     dn = lax.conv_dimension_numbers(
         x2.shape, w2.shape, ("NklC", "klIO", "NklC")
@@ -480,6 +531,210 @@ def _conv4d_cfs(x, w):
 
     _, out = lax.scan(slice_out, None, jnp.arange(i))
     return jnp.moveaxis(out, 0, 1)
+
+
+def _conv4d_cf1(x, w):
+    """Channel-fused conv4d with a 1D convolution core: the ki leading taps
+    fold into INPUT channels, the (kj, kk) taps into OUTPUT channels, and
+    the conv runs over l only.
+
+    At the PF-Pascal middle layer (16->16, 5^4) this is the measured-best
+    XLA formulation (round 3): in-channels ki*cin = 80, out-channels
+    kj*kk*cout = 400 — wide MXU lanes BOTH sides with TRUE FLOPs (no
+    Toeplitz inflation), measured ~84 TFLOP/s true rate vs ~27 for 'tlc'
+    (137 TFLOP/s hardware / 5x inflation). Cost: the conv output
+    materializes at kj*kk/cout x the activation size (5 GB at net batch 16
+    in bf16) — use via per-layer mixing with a lean impl on the 1-channel
+    edge layers, and bound live memory with loss chunking.
+    """
+    b, i, j, k, l, cin = x.shape
+    ki, kj, kk, kl, _, cout = w.shape
+    pi, pj, pk = ki // 2, kj // 2, kk // 2
+    xpad = jnp.pad(x, ((0, 0), (pi, pi)) + ((0, 0),) * 4)
+    # [b, i, j, k, l, ki*cin]: channel block di holds x shifted by di-pi in i
+    xs = jnp.concatenate([xpad[:, di : di + i] for di in range(ki)], axis=-1)
+    w2 = w.transpose(3, 0, 4, 1, 2, 5).reshape(kl, ki * cin, kj * kk * cout)
+    x1 = xs.reshape(b * i * j * k, l, ki * cin)
+    dn = lax.conv_dimension_numbers(
+        x1.shape, w2.shape, ("NWC", "WIO", "NWC")
+    )
+    # epilogue on a 5D view: a 6D [b, i, j, k, l, kj*kk*cout] intermediate
+    # was measured to get a pathological transpose-copy layout from XLA
+    # (4x padded, OOM at the training config); [b*i, j, k, l, N] keeps the
+    # natural minor-dim layout.
+    y = lax.conv_general_dilated(
+        x1,
+        w2,
+        window_strides=(1,),
+        padding="SAME",
+        dimension_numbers=dn,
+        preferred_element_type=x.dtype,
+    ).reshape(b * i, j, k, l, kj * kk * cout)
+    # out[:, m, n] = sum_{dj,dk} y[:, m+dj-pj, n+dk-pk, :, block(dj,dk)]
+    ypad = jnp.pad(y, ((0, 0), (pj, pj), (pk, pk), (0, 0), (0, 0)))
+    out = None
+    for dj in range(kj):
+        for dk in range(kk):
+            t = dj * kk + dk
+            term = ypad[
+                :, dj : dj + j, dk : dk + k, :, t * cout : (t + 1) * cout
+            ]
+            out = term if out is None else out + term
+    return out.reshape(b, i, j, k, l, cout)
+
+
+def _conv4d_ck1(x, w):
+    """Channel-fused conv4d, conv1d core, balanced folding: the (ki, kk)
+    taps fold into INPUT channels, the kj taps into OUTPUT channels, conv
+    over l.
+
+    Complement of `_conv4d_cf1` trading the output blow-up for an input
+    one: in-channels ki*kk*cin (400 at the PF-Pascal middle layer: full
+    contraction lanes), out-channels kj*cout (80), so the conv output is
+    only kj x the activation size and the epilogue shift-sum has kj terms
+    (cf1's kj*kk-term epilogue over a kj*kk-times-larger tensor was the
+    measured bottleneck — slice-sums don't fuse, each term re-reads the
+    padded tensor). The input-side gather is ki*kk shifted copies, read
+    once by the conv. True FLOPs throughout."""
+    b, i, j, k, l, cin = x.shape
+    ki, kj, kk, kl, _, cout = w.shape
+    pi, pj, pk = ki // 2, kj // 2, kk // 2
+    xpad = jnp.pad(
+        x, ((0, 0), (pi, pi), (0, 0), (pk, pk), (0, 0), (0, 0))
+    )
+    # [b, i, j, k, l, ki*kk*cin]: block (di, dk) holds x shifted in i and k
+    xs = jnp.concatenate(
+        [
+            xpad[:, di : di + i, :, dk : dk + k]
+            for di in range(ki)
+            for dk in range(kk)
+        ],
+        axis=-1,
+    )
+    # kernel [kl, (di, dk, cin), (dj, cout)]
+    w2 = w.transpose(3, 0, 2, 4, 1, 5).reshape(
+        kl, ki * kk * cin, kj * cout
+    )
+    x1 = xs.reshape(b * i * j * k, l, ki * kk * cin)
+    dn = lax.conv_dimension_numbers(
+        x1.shape, w2.shape, ("NWC", "WIO", "NWC")
+    )
+    y = lax.conv_general_dilated(
+        x1,
+        w2,
+        window_strides=(1,),
+        padding="SAME",
+        dimension_numbers=dn,
+        preferred_element_type=x.dtype,
+    ).reshape(b * i, j, k, l, kj * cout)  # 5D: see cf1 layout note
+    ypad = jnp.pad(y, ((0, 0), (pj, pj), (0, 0), (0, 0), (0, 0)))
+    out = None
+    for dj in range(kj):
+        term = ypad[:, dj : dj + j, :, :, dj * cout : (dj + 1) * cout]
+        out = term if out is None else out + term
+    return out.reshape(b, i, j, k, l, cout)
+
+
+def _conv4d_tk1(x, w):
+    """conv4d as ki conv1d calls: outer Python loop over the di taps, the
+    kk taps folded into INPUT channels, the kj taps into OUTPUT channels,
+    conv over l.
+
+    Measured rationale (round 3, v5e): XLA lowers conv1d (NWC) near the
+    MXU rate at these shapes while conv2d manages ~1/4 of it, and
+    slice-sum epilogues do not fuse (each term re-reads the padded
+    tensor), so the tap folding must keep EVERY materialized tensor small
+    and every epilogue short. Here each of the ki convs reads the shared
+    (dk, c)-gathered input (kk*cin = 80 lanes) and produces a kj*cout
+    (= 80)-channel output — the di/dj epilogues are ki shifted adds of
+    those 1x-sized outputs. True FLOPs; all intermediates <= kk x input."""
+    b, i, j, k, l, cin = x.shape
+    ki, kj, kk, kl, _, cout = w.shape
+    pi, pj, pk = ki // 2, kj // 2, kk // 2
+    xpad = jnp.pad(x, ((0, 0), (pi, pi), (0, 0), (pk, pk), (0, 0), (0, 0)))
+    # shared (dk, c) gather: [b, i+2pi, j, k, l, kk*cin]
+    xs = jnp.concatenate(
+        [xpad[:, :, :, dk : dk + k] for dk in range(kk)], axis=-1
+    )
+    dn = lax.conv_dimension_numbers(
+        (b * i * j * k, l, kk * cin),
+        (kl, kk * cin, kj * cout),
+        ("NWC", "WIO", "NWC"),
+    )
+    out = None
+    for di in range(ki):
+        # kernel for this di tap: [kl, (dk, cin), (dj, cout)]
+        w_di = w[di].transpose(2, 1, 3, 0, 4).reshape(
+            kl, kk * cin, kj * cout
+        )
+        y = lax.conv_general_dilated(
+            xs[:, di : di + i].reshape(b * i * j * k, l, kk * cin),
+            w_di,
+            window_strides=(1,),
+            padding="SAME",
+            dimension_numbers=dn,
+            preferred_element_type=x.dtype,
+        ).reshape(b * i, j, k, l, kj * cout)
+        out = y if out is None else out + y
+    # dj epilogue: out[:, m] = sum_dj acc[:, m+dj-pj, ..., dj-block]
+    ypad = jnp.pad(out, ((0, 0), (pj, pj), (0, 0), (0, 0), (0, 0)))
+    acc = None
+    for dj in range(kj):
+        term = ypad[:, dj : dj + j, :, :, dj * cout : (dj + 1) * cout]
+        acc = term if acc is None else acc + term
+    return acc.reshape(b, i, j, k, l, cout)
+
+
+def _conv4d_cf1s(x, w, block=5):
+    """`_conv4d_cf1` as a `lax.scan` over BLOCKS of the leading spatial dim.
+
+    cf1's conv output is kj*kk/cout times the activation size (8 GB at the
+    symmetric-batched training config) and OOMs whole; per-block it is
+    1/ceil(i/block) of that, while the conv1d keeps a large enough M
+    (b*block*j*k) to stay near cf1's measured MXU rate (small-M conv1d
+    calls collapse to ~7 TFLOP/s; M >= ~1e5 measured ~84)."""
+    b, i, j, k, l, cin = x.shape
+    ki, kj, kk, kl, _, cout = w.shape
+    pi, pj, pk = ki // 2, kj // 2, kk // 2
+    nb = -(-i // block)
+    ipad = nb * block
+    # pad i by the conv halo plus round-up so every block is full-size
+    xpad = jnp.pad(x, ((0, 0), (pi, pi + ipad - i)) + ((0, 0),) * 4)
+    w2 = w.transpose(3, 0, 4, 1, 2, 5).reshape(kl, ki * cin, kj * kk * cout)
+    dn = lax.conv_dimension_numbers(
+        (b * block * j * k, l, ki * cin), w2.shape, ("NWC", "WIO", "NWC")
+    )
+
+    def block_out(_, blk):
+        window = lax.dynamic_slice_in_dim(
+            xpad, blk * block, block + 2 * pi, axis=1
+        )
+        xs = jnp.concatenate(
+            [window[:, di : di + block] for di in range(ki)], axis=-1
+        )  # [b, block, j, k, l, ki*cin]
+        y = lax.conv_general_dilated(
+            xs.reshape(b * block * j * k, l, ki * cin),
+            w2,
+            window_strides=(1,),
+            padding="SAME",
+            dimension_numbers=dn,
+            preferred_element_type=x.dtype,
+        ).reshape(b * block, j, k, l, kj * kk * cout)
+        ypad = jnp.pad(y, ((0, 0), (pj, pj), (pk, pk), (0, 0), (0, 0)))
+        acc = None
+        for dj in range(kj):
+            for dk in range(kk):
+                t = dj * kk + dk
+                term = ypad[
+                    :, dj : dj + j, dk : dk + k, :, t * cout : (t + 1) * cout
+                ]
+                acc = term if acc is None else acc + term
+        return None, acc.reshape(b, block, j, k, l, cout)
+
+    _, out = lax.scan(block_out, None, jnp.arange(nb))
+    # [nb, b, block, j, k, l, cout] -> [b, nb*block, ...] -> trim round-up
+    out = jnp.moveaxis(out, 0, 1).reshape(b, ipad, j, k, l, cout)
+    return out[:, :i]
 
 
 def _gemm_kernel(w):
@@ -590,14 +845,20 @@ def conv4d(x, w, bias=None, impl="xla", interpret=None):
         semantics, lib/conv4d.py:41-48).
       impl: 'xla' (one rank-4 conv HLO) | 'taps' (per-tap conv3d sum) |
         'scan' (sequential over i, minimal memory) | 'tlc' (Toeplitz-l
-        conv3d, 5x FLOPs but wide lanes) | 'btl' (blocked Toeplitz-l:
-        ~3.1x FLOPs, 192/128-wide lanes) | 'tlcv' (tlc forward + custom
+        conv3d, 5x FLOPs but wide lanes) | 'btl'/'btl2'/'btl4'/'btl5'
+        (blocked Toeplitz-l at block 8/2/4/5: lower FLOP inflation,
+        narrower lanes; block 4 is the measured sweet spot for the
+        16->16 middle NC layer) | 'tlcv' (tlc forward + custom
         VJP with a true-FLOP rank-4 kernel gradient — measured SLOWER
         end-to-end than tlc, kept as a documented negative result) |
         'tf3'/'tf2' (taps folded into
         output channels + shift-sum) | 'cf'/'cfs' (taps folded into BOTH
         input and output channels of one conv2d — true FLOPs, wide lanes
         both directions; 'cfs' is the scanned low-memory variant) |
+        'cf1' (ki taps into input channels, (kj, kk) taps into output
+        channels, conv1d over l: true FLOPs with ki*cin / kj*kk*cout
+        lanes — the measured-best middle-layer impl, at a large transient
+        memory cost) |
         'gemm'/'gemms' ((di, dl) taps gathered into the contraction dim,
         (dj, dk) into output channels: ONE full-lane MXU GEMM, true FLOPs;
         'gemms' is the scanned low-memory variant) |
@@ -626,6 +887,12 @@ def conv4d(x, w, bias=None, impl="xla", interpret=None):
         out = _conv4d_tlc(x, w)
     elif impl == "btl":
         out = _conv4d_btl(x, w)
+    elif impl == "btl4":
+        out = _conv4d_btl(x, w, block=4)
+    elif impl == "btl2":
+        out = _conv4d_btl(x, w, block=2)
+    elif impl == "btl5":
+        out = _conv4d_btl(x, w, block=5)
     elif impl == "tlcv":
         out = _conv4d_tlcv(x, w)
     elif impl == "tf3":
@@ -636,6 +903,14 @@ def conv4d(x, w, bias=None, impl="xla", interpret=None):
         out = _conv4d_cf(x, w)
     elif impl == "cfs":
         out = _conv4d_cfs(x, w)
+    elif impl == "cf1":
+        out = _conv4d_cf1(x, w)
+    elif impl == "cf1s":
+        out = _conv4d_cf1s(x, w)
+    elif impl == "ck1":
+        out = _conv4d_ck1(x, w)
+    elif impl == "tk1":
+        out = _conv4d_tk1(x, w)
     elif impl == "gemm":
         out = _conv4d_gemm(x, w)
     elif impl == "gemms":

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ncnet_tpu.ops.conv4d import conv4d
+from ncnet_tpu.ops.conv4d import conv4d, resolve_layer_impls
 from ncnet_tpu.ops.correlation import correlation_4d, correlation_maxpool4d
 
 
@@ -83,18 +83,20 @@ def _swap_ab_sharded(x, axis_name):
 
 def neigh_consensus_sharded(params, corr, axis_name, symmetric=True, impl="xla"):
     """Symmetric NC stack on an iA-sharded correlation slab (with channel
-    axis handling identical to `neigh_consensus_apply`)."""
+    axis and per-layer impl handling identical to `neigh_consensus_apply`)."""
     dtype = corr.dtype
 
+    layer_impls = resolve_layer_impls(impl, len(params))
+
     def net(x):
-        for p in params:
+        for p, layer_impl in zip(params, layer_impls):
             x = jax.nn.relu(
                 conv4d_sharded(
                     x,
                     p["kernel"].astype(dtype),
                     p["bias"].astype(dtype),
                     axis_name,
-                    impl=impl,
+                    impl=layer_impl,
                 )
             )
         return x

@@ -37,6 +37,30 @@ def _device_batch(mesh, batch):
     return {k: jnp.asarray(v) for k, v in sub.items()}
 
 
+def _prefetch_device_batches(mesh, loader, size=2):
+    """Double-buffer host->device transfers: the transfer for batch i+1 is
+    issued while step i runs on the device (device_put is asynchronous), so
+    H2D never sits on the critical path between steps. ``size=2`` is the
+    standard flax prefetch depth: one batch in flight, one being consumed."""
+    from collections import deque
+
+    queue = deque()
+    it = iter(loader)
+
+    def enqueue():
+        try:
+            queue.append(_device_batch(mesh, next(it)))
+        except StopIteration:
+            return False
+        return True
+
+    while len(queue) < size and enqueue():
+        pass
+    while queue:
+        yield queue.popleft()
+        enqueue()
+
+
 def train(
     config,
     params,
@@ -101,7 +125,7 @@ def train(
         t0 = time.time()
         t_last = t0
         losses = []
-        for i, batch in enumerate(train_loader):
+        for i, dbatch in enumerate(_prefetch_device_batches(mesh, train_loader)):
             if profile_dir and epoch == start_epoch:
                 if i == profile_steps[0]:
                     jax.profiler.start_trace(profile_dir)
@@ -115,7 +139,7 @@ def train(
                     jax.profiler.stop_trace()
                     profiling = False
                     print(f"profile trace written to {profile_dir}", flush=True)
-            state, loss = train_step(state, _device_batch(mesh, batch))
+            state, loss = train_step(state, dbatch)
             if (i + 1) % log_every == 0:
                 # the float() D2H sync makes the step timing honest
                 loss_host = float(loss)
@@ -137,8 +161,8 @@ def train(
         val_loss = float("nan")
         if val_loader is not None:
             vlosses = [
-                float(eval_step(state.params, _device_batch(mesh, b)))
-                for b in val_loader
+                float(eval_step(state.params, b))
+                for b in _prefetch_device_batches(mesh, val_loader)
             ]
             val_loss = float(np.mean(vlosses)) if vlosses else float("nan")
         val_hist.append(val_loss)

@@ -82,6 +82,16 @@ def weak_loss(params, config, batch, normalization="softmax"):
 
     chunk = getattr(config, "loss_chunk", 0) or 0
     b = feat_a.shape[0]
+    if chunk >= b > 0 and getattr(config, "loss_chunk_remat", True):
+        # One chunk covering the whole batch: apply the same conv-saving
+        # remat WITHOUT the lax.map loop (buffers crossing the loop get
+        # layout-pessimized by XLA; a plain checkpoint does not).
+        remat_fn = jax.checkpoint(
+            lambda fa, fb, fan: pair_scores(fa, fb, fan),
+            policy=jax.checkpoint_policies.save_only_these_names("nc_conv"),
+        )
+        pos, neg = remat_fn(feat_a, feat_b, feat_a_neg)
+        return jnp.mean(neg) - jnp.mean(pos)
     if 0 < chunk < b:
         if b % chunk:
             raise ValueError(f"batch {b} not divisible by loss_chunk {chunk}")
@@ -97,7 +107,10 @@ def weak_loss(params, config, batch, normalization="softmax"):
             # neigh_consensus_apply) across the remat boundary: the
             # backward pass then re-runs only the cheap elementwise ops
             # (MM ratios, relu, softmax scores), not the convolutions —
-            # the convs are ~98% of the chunk's forward FLOPs.
+            # the convs are ~98% of the chunk's forward FLOPs. (Also
+            # saving the channel-fused impls' gathered patches was
+            # measured WORSE: buffers living across the lax.map loop get
+            # layout-pessimized by XLA — 5.1x padding, OOM.)
             chunk_fn = jax.checkpoint(
                 chunk_fn,
                 policy=jax.checkpoint_policies.save_only_these_names(

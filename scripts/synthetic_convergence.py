@@ -100,7 +100,20 @@ def main():
     p.add_argument("--lr", type=float, default=5e-3)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--alpha", type=float, default=0.15)
-    p.add_argument("--conv4d_impl", type=str, default="cfs")
+    # same surface as scripts/train.py: no 'pallas' (interpret-mode only);
+    # comma-separated per-layer lists allowed; registry from the library
+    def impl_arg(value):
+        from ncnet_tpu.ops.conv4d import CONV4D_IMPLS
+
+        for name in value.split(","):
+            if name not in CONV4D_IMPLS:
+                raise argparse.ArgumentTypeError(
+                    f"unknown conv4d impl {name!r} (choose from "
+                    f"{', '.join(CONV4D_IMPLS)})"
+                )
+        return value
+
+    p.add_argument("--conv4d_impl", type=impl_arg, default="cfs")
     p.add_argument("--ncons_kernel_sizes", nargs="+", type=int, default=[3, 3])
     p.add_argument("--ncons_channels", nargs="+", type=int, default=[16, 1])
     args = p.parse_args()

@@ -23,6 +23,21 @@ from ncnet_tpu.train.checkpoint import load_checkpoint
 from ncnet_tpu.train.loop import train
 
 
+def _conv4d_impl_arg(value):
+    """Every advertised value trains on TPU; 'pallas' (interpret-mode
+    only) is deliberately absent. A comma-separated list picks an impl
+    per NC layer. The registry lives next to the dispatch it mirrors."""
+    from ncnet_tpu.ops.conv4d import CONV4D_IMPLS
+
+    for name in value.split(","):
+        if name not in CONV4D_IMPLS:
+            raise argparse.ArgumentTypeError(
+                f"unknown conv4d impl {name!r} (choose from "
+                f"{', '.join(CONV4D_IMPLS)}; comma-separate for per-layer)"
+            )
+    return value
+
+
 def main():
     p = argparse.ArgumentParser(description="ncnet_tpu training")
     p.add_argument("--dataset_image_path", type=str, default="datasets/pf-pascal")
@@ -61,10 +76,14 @@ def main():
     p.add_argument("--multihost", action="store_true",
                    help="join a multi-host JAX runtime (TPU pod slices: "
                         "auto-detected); shards the data loaders per host")
-    p.add_argument("--conv4d_impl", type=str, default="tlc",
-                   choices=["xla", "taps", "scan", "tlc", "btl", "tlcv",
-                            "tf3", "tf2", "cf", "cfs", "gemm", "gemms",
-                            "pallas"])
+    # 'pallas' is deliberately NOT offered: the kernel lowers only in
+    # interpret mode (kernels/conv4d_pallas.py STATUS) — advertising it
+    # here would crash mid-training on the target hardware.
+    p.add_argument("--conv4d_impl", type=_conv4d_impl_arg, default=None,
+                   help="conv4d lowering, one name or a comma-separated "
+                        "per-NC-layer list. Default: the measured-best "
+                        "per-layer mix 'tlc,btl4,tlc' for 3-layer NC "
+                        "configs, 'tlc' otherwise (see ops/conv4d.py)")
     p.add_argument("--loss_chunk", type=int, default=None,
                    help="run the correlation->NC->score loss over sample "
                         "chunks of this size (0 = whole batch; when "
@@ -73,6 +92,11 @@ def main():
                         "bench.py); leave unset for multi-device data "
                         "parallelism")
     args = p.parse_args()
+
+    def default_impl(n_layers):
+        # per-layer defaults must match the NC layer count (checkpoints
+        # carry their own architecture; an explicit flag always wins)
+        return "tlc,btl4,tlc" if n_layers == 3 else "tlc"
 
     host_id, n_hosts = 0, 1
     if args.multihost:
@@ -128,7 +152,9 @@ def main():
         config, params = convert_checkpoint(args.checkpoint)
         chunk = args.loss_chunk or 0
         config = config.replace(
-            half_precision=args.bf16, conv4d_impl=args.conv4d_impl,
+            half_precision=args.bf16,
+            conv4d_impl=args.conv4d_impl
+            or default_impl(len(config.ncons_channels)),
             loss_chunk=chunk, nc_remat=chunk == 0,
         )
         print(f"initialized from reference checkpoint {args.checkpoint} "
@@ -136,6 +162,8 @@ def main():
     elif args.checkpoint:
         ck = load_checkpoint(args.checkpoint)
         config, params = ck.config, ck.params
+        if args.conv4d_impl:  # explicit flag overrides the checkpoint's
+            config = config.replace(conv4d_impl=args.conv4d_impl)
         if args.loss_chunk is not None:  # explicit flag overrides
             config = config.replace(
                 loss_chunk=args.loss_chunk,
@@ -174,7 +202,8 @@ def main():
             ncons_kernel_sizes=tuple(args.ncons_kernel_sizes),
             ncons_channels=tuple(args.ncons_channels),
             half_precision=args.bf16,
-            conv4d_impl=args.conv4d_impl,
+            conv4d_impl=args.conv4d_impl
+            or default_impl(len(args.ncons_channels)),
             loss_chunk=args.loss_chunk or 0,
             # chunking brings its own conv-saving remat policy; per-layer
             # remat is the memory bound for the unchunked path

@@ -36,7 +36,7 @@ def conv4d_bruteforce(x, w, bias=None):
 @pytest.mark.parametrize(
     "impl",
     ["xla", "taps", "scan", "tlc", "btl", "tlcv", "tf3", "tf2", "cf",
-     "cfs", "gemm", "gemms", "pallas"],
+     "cfs", "cf1", "cf1s", "ck1", "tk1", "btl2", "btl4", "btl5", "gemm", "gemms", "pallas"],
 )
 @pytest.mark.parametrize("ksize,cin,cout", [(3, 1, 2), (5, 2, 1)])
 def test_conv4d_matches_bruteforce(impl, ksize, cin, cout):
@@ -52,7 +52,7 @@ def test_conv4d_matches_bruteforce(impl, ksize, cin, cout):
 @pytest.mark.parametrize(
     "impl",
     ["taps", "scan", "tlc", "btl", "tlcv", "tf3", "tf2", "cf", "cfs",
-     "gemm", "gemms", "pallas"],
+     "cf1", "cf1s", "ck1", "tk1", "btl2", "btl4", "btl5", "gemm", "gemms", "pallas"],
 )
 def test_conv4d_impls_agree_with_grad(impl):
     rng = np.random.RandomState(1)
@@ -116,3 +116,18 @@ def test_conv4d_matches_torch_conv3d_decomposition():
             )
     want = out.numpy().transpose(0, 2, 3, 4, 5, 1)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_registry_names_all_dispatch():
+    """Every name in the canonical CONV4D_IMPLS registry (the CLI
+    validators' source of truth) must actually dispatch in conv4d()."""
+    from ncnet_tpu.ops.conv4d import CONV4D_IMPLS
+
+    rng = np.random.RandomState(9)
+    x = jnp.asarray(rng.randn(1, 3, 3, 3, 3, 2).astype(np.float32))
+    w = jnp.asarray(rng.randn(3, 3, 3, 3, 2, 2).astype(np.float32))
+    want = np.asarray(conv4d(x, w, impl="xla"))
+    for impl in CONV4D_IMPLS:
+        got = np.asarray(conv4d(x, w, impl=impl))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4,
+                                   err_msg=impl)

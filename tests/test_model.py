@@ -89,3 +89,29 @@ def test_wrapper_and_checkpoint_roundtrip(tiny_model, tmp_path):
     loaded = load_checkpoint(path)
     chex = pytest.importorskip("chex")
     chex.assert_trees_all_close(loaded.params, jax.tree.map(np.asarray, tiny_model))
+
+
+def test_per_layer_conv4d_impl_mixing():
+    """A comma-separated conv4d impl list applies per NC layer and matches
+    the uniform-impl result (the measured-best config mixes 'tlc' edges
+    with a 'cf1' middle layer)."""
+    import numpy as np
+
+    from ncnet_tpu.models.neigh_consensus import (
+        init_neigh_consensus,
+        neigh_consensus_apply,
+    )
+
+    rng = np.random.RandomState(5)
+    params = init_neigh_consensus(
+        jax.random.PRNGKey(5), kernel_sizes=(3, 3, 3), channels=(4, 4, 1)
+    )
+    corr = jnp.asarray(rng.randn(2, 5, 5, 5, 5).astype(np.float32))
+    want = np.asarray(neigh_consensus_apply(params, corr, impl="xla"))
+    got = np.asarray(
+        neigh_consensus_apply(params, corr, impl="tlc,cf1,scan")
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    with pytest.raises(ValueError, match="does not match"):
+        neigh_consensus_apply(params, corr, impl="tlc,cf1")

@@ -36,7 +36,7 @@ if not __import__("os").path.isdir(f"{REF_ROOT}/lib"):
 # All conv4d lowerings that run on the CPU test platform.
 CONV4D_IMPLS = [
     "xla", "taps", "scan", "tlc", "btl", "tlcv", "tf3", "tf2",
-    "cf", "cfs", "gemm", "gemms",
+    "cf", "cfs", "cf1", "cf1s", "ck1", "tk1", "btl2", "btl4", "btl5", "gemm", "gemms",
 ]
 
 
