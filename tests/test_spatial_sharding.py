@@ -117,3 +117,19 @@ def test_inloc_match_fn_sharded_agrees_with_unsharded():
             np.testing.assert_allclose(
                 np.asarray(g), np.asarray(w), rtol=1e-4, atol=1e-5
             )
+
+
+def test_sharded_pipeline_per_layer_impls():
+    """The sharded NC stack accepts the same comma-separated per-layer
+    conv4d impl lists as the unsharded one."""
+    cfg = CFG.replace(conv4d_impl="tlc,scan")
+    mesh = make_mesh((2,), ("spatial",), devices=jax.devices()[:2])
+    params = init_immatchnet(jax.random.PRNGKey(6), cfg)
+    rng = np.random.RandomState(6)
+    fa = jnp.asarray(rng.randn(1, 8, 5, 8).astype(np.float32))
+    fb = jnp.asarray(rng.randn(1, 8, 5, 8).astype(np.float32))
+    want = np.asarray(match_pipeline(params["neigh_consensus"], cfg, fa, fb))
+    got = np.asarray(
+        make_sharded_match_pipeline(cfg, mesh)(params["neigh_consensus"], fa, fb)
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

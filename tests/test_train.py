@@ -231,3 +231,23 @@ def test_synthetic_convergence_slow():
     )
     assert out["loss_last"] < out["loss_first"]
     assert out["pck_after"] > out["pck_before"]
+
+
+def test_prefetch_device_batches_order_and_count():
+    """The H2D double-buffer must preserve batch order and count, and
+    handle empty and shorter-than-depth loaders."""
+    from ncnet_tpu.train.loop import _prefetch_device_batches
+
+    def loader(n):
+        return [
+            {"source_image": np.full((1, 4, 4, 3), i, np.float32),
+             "target_image": np.full((1, 4, 4, 3), -i, np.float32)}
+            for i in range(n)
+        ]
+
+    for n in (0, 1, 2, 5):
+        out = list(_prefetch_device_batches(None, loader(n)))
+        assert len(out) == n
+        for i, b in enumerate(out):
+            assert float(b["source_image"][0, 0, 0, 0]) == i
+            assert float(b["target_image"][0, 0, 0, 0]) == -i
