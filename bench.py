@@ -39,9 +39,12 @@ pairs/s end-to-end, block 5 = 14.0, block 8 = 14.6, dense 'tlc' = 11.9);
 the 1-channel edge layers keep the dense Toeplitz ('tlc'). 'tf2' on the
 16->1 layer wins in isolation (8.4 vs 27.4 ms/pass) but loses end-to-end
 under the remat loop (13.6). Batch 32 changes nothing (15.9 — per-pair
-cost is flat). Negative results kept as impls for the record: 'cf1'
-(epilogue-bound), 'cf1s'/'ck1'/'tk1' (scan kills fusion / 6D gathers),
-'tlcv' (true-FLOP dw slower than the inflated one it replaces).
+cost is flat), and fusing the pos+neg pipelines into one double-batch
+call measures 14.0 (the larger live batch through the stack loses more
+than the halved op count saves). Negative results kept as impls for the
+record: 'cf1' (epilogue-bound), 'cf1s'/'ck1'/'tk1' (scan kills fusion /
+6D gathers), 'tlcv' (true-FLOP dw slower than the inflated one it
+replaces).
 
 Baseline: the reference repo publishes no throughput numbers (BASELINE.md).
 ``V100_EST_PAIRS_PER_SEC`` is an analytic estimate for the reference

@@ -7,6 +7,7 @@ Improvements over the reference: exact resume (optimizer state + epoch),
 data-parallel over a device mesh, donate-args jitted step.
 """
 
+import json
 import os
 import time
 
@@ -120,6 +121,12 @@ def train(
     # Optional jax.profiler capture (SURVEY §5: the reference has no
     # tracing at all): trace steps [profile_steps) of the first epoch into
     # profile_dir, viewable with tensorboard/xprof.
+    metrics_path = os.path.join(checkpoint_dir, "metrics.jsonl")
+    if jax.process_index() == 0 and start_epoch == 0:
+        # fresh (non-resume) run: don't mix epochs with a prior run's
+        # lines; resume keeps appending to its own history
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        open(metrics_path, "w").close()
     profiling = False
     for epoch in range(start_epoch, num_epochs):
         t0 = time.time()
@@ -169,14 +176,39 @@ def train(
         is_best = val_loss < best_val
         best_val = min(best_val, val_loss) if not np.isnan(val_loss) else best_val
 
+        epoch_s = time.time() - t0
         print(
             f"epoch {epoch + 1}/{num_epochs}: train {train_loss:.6f} "
-            f"val {val_loss:.6f} ({time.time() - t0:.1f}s)"
+            f"val {val_loss:.6f} ({epoch_s:.1f}s)"
             + (" [best]" if is_best else ""),
             flush=True,
         )
         if jax.process_index() != 0:
             continue  # multi-host: only process 0 writes checkpoints
+        # Persisted observability (SURVEY §5: the reference is print-only;
+        # its loss arrays live only inside checkpoints): per-epoch metrics
+        # as JSONL plus a loss-curve figure, next to the checkpoint.
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        with open(metrics_path, "a") as f:
+            f.write(json.dumps({
+                "epoch": epoch + 1,
+                "train_loss": train_loss,
+                # strict JSON: NaN (no/empty val loader) is not valid JSON
+                "val_loss": None if np.isnan(val_loss) else val_loss,
+                "epoch_seconds": round(epoch_s, 2),
+                "steps": int(state.step),
+                "best": bool(is_best),
+            }) + "\n")
+        try:
+            import matplotlib.pyplot as plt
+
+            from ncnet_tpu.utils.plot import plot_loss_curves, save_plot
+
+            fig = plot_loss_curves(train_hist, val_hist)
+            save_plot(os.path.join(checkpoint_dir, "loss_curve.png"), fig=fig)
+            plt.close(fig)
+        except Exception as e:  # headless plotting must never kill training
+            print(f"loss-curve plot skipped: {e}", flush=True)
         save_checkpoint(
             os.path.join(checkpoint_dir, checkpoint_name),
             CheckpointData(

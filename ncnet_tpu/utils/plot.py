@@ -52,6 +52,22 @@ def save_plot(filename, fig=None, dpi=150):
     fig.savefig(filename, dpi=dpi, bbox_inches="tight", pad_inches=0.05)
 
 
+def plot_loss_curves(train_hist, val_hist):
+    """Train/val loss per epoch — the persisted form of the loss arrays
+    the reference only stores inside its checkpoints (train.py:203-204)."""
+    plt = _plt()
+    fig, ax = plt.subplots(figsize=(6, 4))
+    epochs = np.arange(1, len(train_hist) + 1)
+    ax.plot(epochs, train_hist, "-o", ms=3, label="train")
+    if val_hist is not None and len(val_hist):
+        ax.plot(epochs[: len(val_hist)], val_hist, "-s", ms=3, label="val")
+    ax.set_xlabel("Epoch")
+    ax.set_ylabel("Weak-supervision loss")
+    ax.grid(True, alpha=0.3)
+    ax.legend()
+    return fig
+
+
 def plot_localization_curve(thresholds_m, rate_percent, label="ncnet_tpu"):
     """Localization-rate curve figure — % correctly localized queries vs
     distance threshold, the reference's final InLoc deliverable
