@@ -31,14 +31,20 @@ A Pallas kernel cannot beat this either: Mosaic requires 8-aligned
 sublane offsets, but conv4d row shifts have granularity 1 in the fused
 (j,k) dims, forcing the same banded/inflated formulations (>=3.2x
 effective with K/N pads) that XLA already runs at 70% peak.
-Best known config (15.86 pairs/s, 13.9% MFU): PER-LAYER impl mixing
-'tlc,btl4,tlc' + loss_chunk 8 + 'nc_conv' save-policy remat. The middle
-16->16 layer (89% of stack FLOPs) uses the 5D-safe blocked Toeplitz at
-block 4 (1.79x inflation, the measured sweet spot: block 2 = 14.0
-pairs/s end-to-end, block 5 = 14.0, block 8 = 14.6, dense 'tlc' = 11.9);
-the 1-channel edge layers keep the dense Toeplitz ('tlc'). 'tf2' on the
-16->1 layer wins in isolation (8.4 vs 27.4 ms/pass) but loses end-to-end
-under the remat loop (13.6). Batch 32 changes nothing (15.9 — per-pair
+Best known config (16.0 pairs/s, 14.1% MFU, vs_baseline 4.0): PER-LAYER
+impl mixing 'tlc,btl4,tlc/tlc' + loss_chunk 8 + 'nc_conv' save-policy
+remat. The middle 16->16 layer (89% of stack FLOPs) uses the 5D-safe
+blocked Toeplitz at block 4 (1.79x inflation, the measured sweet spot:
+block 2 = 14.0 pairs/s end-to-end, block 5 = 14.0, block 8 = 14.6, dense
+'tlc' = 11.9); the 1-channel edge layers keep the dense Toeplitz
+('tlc'), with the LAST layer's input gradient computed via an explicit
+'tlc' conv4d instead of XLA's autodiff transpose (the '<fwd>/<dx>'
+composite — XLA's transpose of the 16->1 tlc conv was the hottest
+single op of the step). dx-composites measured WORSE elsewhere:
+'tlc/btl' on layer 3 = 15.1, 'btl4/btl4' middle = 15.4, 'tf2/tlc' =
+15.3, composite on layer 1 = 15.7. 'tf2' forward on the 16->1 layer
+wins in isolation (8.4 vs 27.4 ms/pass) but loses end-to-end under the
+remat loop (13.6). Batch 32 changes nothing (15.9 — per-pair
 cost is flat), and fusing the pos+neg pipelines into one double-batch
 call measures 14.0 (the larger live batch through the stack loses more
 than the halved op count saves). Negative results kept as impls for the
@@ -88,8 +94,10 @@ def train_step_flops(batch, grid=25, feat_ch=1024, image=400):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--conv4d_impl", default="tlc,btl4,tlc",
-                   help="one impl or a comma-separated per-NC-layer list")
+    p.add_argument("--conv4d_impl", default="tlc,btl4,tlc/tlc",
+                   help="one impl or a comma-separated per-NC-layer list; "
+                        "'<fwd>/<dx>' composes forward and input-grad "
+                        "lowerings (measured-best default)")
     p.add_argument("--nc_remat", action="store_true")
     p.add_argument("--no_chunk_remat", action="store_true",
                    help="disable per-chunk rematerialization (needs the "
@@ -99,7 +107,10 @@ def main():
                    help="run the symmetric NC passes sequentially instead "
                         "of double-batched (halves stack live memory)")
     p.add_argument("--batch", type=int, default=16)
-    p.add_argument("--steps", type=int, default=10)
+    # the platform's ~80 ms D2H roundtrip is paid ONCE for the whole timed
+    # chain; more steps amortize that measurement constant (it is not part
+    # of the training step itself)
+    p.add_argument("--steps", type=int, default=30)
     args = p.parse_args()
 
     import jax

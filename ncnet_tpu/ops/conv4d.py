@@ -38,6 +38,12 @@ CONV4D_IMPLS = (
 )
 
 
+def is_valid_impl(name):
+    """True for a registry name or a '<fwd>/<dx>' composite of two."""
+    parts = name.split("/")
+    return 1 <= len(parts) <= 2 and all(p in CONV4D_IMPLS for p in parts)
+
+
 def resolve_layer_impls(impl, n_layers):
     """One impl name or a comma-separated per-layer list -> list of
     ``n_layers`` names (shared by the unsharded and sharded NC stacks)."""
@@ -178,8 +184,7 @@ def _conv4d_tlcv_fwd(x, w):
 
 def _conv4d_tlcv_bwd(res, g):
     x, w = res
-    w_flip = jnp.flip(w, axis=(0, 1, 2, 3)).transpose(0, 1, 2, 3, 5, 4)
-    dx = _conv4d_tlc(g, w_flip.astype(g.dtype))
+    dx = _conv4d_tlc(g, _flip_transpose(w).astype(g.dtype))
     # conv4d is linear in w: transpose directly (jax.vjp would evaluate
     # and discard a full extra primal forward outside jit)
     transpose_w = jax.linear_transpose(lambda ww: _conv4d_xla(x, ww), w)
@@ -835,6 +840,57 @@ def _conv4d_gemms(x, w):
     return jnp.moveaxis(out, 0, 1)
 
 
+def _flip_transpose(w):
+    """Filters of the conv4d input-gradient identity: spatially flipped,
+    in/out channels swapped (stride-1 SAME, odd kernels)."""
+    return jnp.flip(w, axis=(0, 1, 2, 3)).transpose(0, 1, 2, 3, 5, 4)
+
+
+_COMPOSITE_CACHE = {}
+
+
+def _composite_conv4d(fwd_impl, dx_impl):
+    """conv4d with independent forward and input-gradient lowerings
+    (impl string '<fwd>/<dx>').
+
+    Motivation (round 3, measured): XLA's autodiff transposes a conv in
+    the SAME formulation as its forward. For the 16->1 NC layer under
+    'tlc' that transpose is a 25-in/400-out-channel conv3d — 128-lane
+    padding on the 25 side makes it ~66x the layer's true FLOPs and the
+    single hottest op of the whole training step (66 ms of a 241 ms
+    stack f+b). dx is itself a conv4d (flipped/transposed filters), so
+    it can use whichever lowering fits ITS channel shape — 'tlc/btl'
+    computes the same gradient as a 1->16-shaped 'btl' forward (~15 ms).
+    dw keeps the forward formulation's linear transpose (the tlcv
+    experiment showed swapping dw forms is a loss).
+    """
+    key = (fwd_impl, dx_impl)
+    if key in _COMPOSITE_CACHE:
+        return _COMPOSITE_CACHE[key]
+
+    @jax.custom_vjp
+    def f(x, w):
+        return conv4d(x, w, impl=fwd_impl)
+
+    def fwd(x, w):
+        return f(x, w), (x, w)
+
+    def bwd(res, g):
+        x, w = res
+        dx = conv4d(g, _flip_transpose(w).astype(g.dtype), impl=dx_impl)
+        # conv4d is linear in w: transpose the forward formulation
+        # directly (jax.vjp would evaluate and discard an extra primal)
+        transpose_w = jax.linear_transpose(
+            lambda ww: conv4d(x, ww, impl=fwd_impl), w
+        )
+        (dw,) = transpose_w(g)
+        return dx, dw
+
+    f.defvjp(fwd, bwd)
+    _COMPOSITE_CACHE[key] = f
+    return f
+
+
 def conv4d(x, w, bias=None, impl="xla", interpret=None):
     """SAME, stride-1 4D convolution.
 
@@ -877,6 +933,17 @@ def conv4d(x, w, bias=None, impl="xla", interpret=None):
             impl="pallas", interpret=interpret,
         )
         return out.reshape(b, i, j, k, l, cout)
+    if "/" in impl:
+        if not is_valid_impl(impl):
+            raise ValueError(
+                f"invalid composite conv4d impl {impl!r} (expect "
+                "'<fwd>/<dx>' with both names from CONV4D_IMPLS)"
+            )
+        fwd_impl, dx_impl = impl.split("/")
+        out = _composite_conv4d(fwd_impl, dx_impl)(x, w)
+        if bias is not None:
+            out = out + bias
+        return out
     if impl == "xla":
         out = _conv4d_xla(x, w)
     elif impl == "taps":

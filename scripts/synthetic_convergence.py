@@ -103,13 +103,13 @@ def main():
     # same surface as scripts/train.py: no 'pallas' (interpret-mode only);
     # comma-separated per-layer lists allowed; registry from the library
     def impl_arg(value):
-        from ncnet_tpu.ops.conv4d import CONV4D_IMPLS
+        from ncnet_tpu.ops.conv4d import CONV4D_IMPLS, is_valid_impl
 
         for name in value.split(","):
-            if name not in CONV4D_IMPLS:
+            if not is_valid_impl(name):
                 raise argparse.ArgumentTypeError(
                     f"unknown conv4d impl {name!r} (choose from "
-                    f"{', '.join(CONV4D_IMPLS)})"
+                    f"{', '.join(CONV4D_IMPLS)}; '<fwd>/<dx>' composes)"
                 )
         return value
 

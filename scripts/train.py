@@ -27,13 +27,14 @@ def _conv4d_impl_arg(value):
     """Every advertised value trains on TPU; 'pallas' (interpret-mode
     only) is deliberately absent. A comma-separated list picks an impl
     per NC layer. The registry lives next to the dispatch it mirrors."""
-    from ncnet_tpu.ops.conv4d import CONV4D_IMPLS
+    from ncnet_tpu.ops.conv4d import CONV4D_IMPLS, is_valid_impl
 
     for name in value.split(","):
-        if name not in CONV4D_IMPLS:
+        if not is_valid_impl(name):
             raise argparse.ArgumentTypeError(
                 f"unknown conv4d impl {name!r} (choose from "
-                f"{', '.join(CONV4D_IMPLS)}; comma-separate for per-layer)"
+                f"{', '.join(CONV4D_IMPLS)}; comma-separate for per-layer; "
+                "'<fwd>/<dx>' composes forward and input-grad lowerings)"
             )
     return value
 
@@ -81,8 +82,9 @@ def main():
     # here would crash mid-training on the target hardware.
     p.add_argument("--conv4d_impl", type=_conv4d_impl_arg, default=None,
                    help="conv4d lowering, one name or a comma-separated "
-                        "per-NC-layer list. Default: the measured-best "
-                        "per-layer mix 'tlc,btl4,tlc' for 3-layer NC "
+                        "per-NC-layer list ('<fwd>/<dx>' composes forward "
+                        "and input-grad lowerings). Default: the measured-"
+                        "best mix 'tlc,btl4,tlc/tlc' for 3-layer NC "
                         "configs, 'tlc' otherwise (see ops/conv4d.py)")
     p.add_argument("--loss_chunk", type=int, default=None,
                    help="run the correlation->NC->score loss over sample "
@@ -96,7 +98,7 @@ def main():
     def default_impl(n_layers):
         # per-layer defaults must match the NC layer count (checkpoints
         # carry their own architecture; an explicit flag always wins)
-        return "tlc,btl4,tlc" if n_layers == 3 else "tlc"
+        return "tlc,btl4,tlc/tlc" if n_layers == 3 else "tlc"
 
     host_id, n_hosts = 0, 1
     if args.multihost:

@@ -131,3 +131,25 @@ def test_registry_names_all_dispatch():
         got = np.asarray(conv4d(x, w, impl=impl))
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4,
                                    err_msg=impl)
+
+
+def test_composite_impl_grads_match_xla():
+    """'<fwd>/<dx>' composites: forward uses one lowering, the input
+    gradient another (round-3 fix for XLA's pathological conv transposes
+    on asymmetric-channel layers); values and ALL grads must match."""
+    rng = np.random.RandomState(11)
+    x = jnp.asarray(rng.randn(1, 4, 4, 4, 4, 3).astype(np.float32))
+    w = jnp.asarray(rng.randn(3, 3, 3, 3, 3, 1).astype(np.float32))
+    b = jnp.asarray(rng.randn(1).astype(np.float32))
+
+    f_xla = lambda x_, w_, b_: jnp.sum(jnp.sin(conv4d(x_, w_, b_, impl="xla")))
+    f_cmp = lambda x_, w_, b_: jnp.sum(
+        jnp.sin(conv4d(x_, w_, b_, impl="tlc/btl"))
+    )
+    np.testing.assert_allclose(f_xla(x, w, b), f_cmp(x, w, b), rtol=1e-5)
+    g_xla = jax.grad(f_xla, argnums=(0, 1, 2))(x, w, b)
+    g_cmp = jax.grad(f_cmp, argnums=(0, 1, 2))(x, w, b)
+    for a, bb in zip(g_xla, g_cmp):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(bb), rtol=1e-3, atol=1e-4
+        )
