@@ -1,0 +1,107 @@
+"""Microbenchmark: conv4d KERNEL-gradient (dw) formulations in isolation.
+
+Round-4 question (VERDICT #1): the middle 16->16 NC layer carries 89% of
+the stack FLOPs and its dw is computed by `jax.linear_transpose` of the
+blocked-Toeplitz forward — a 1.79x-inflated conv3d. Candidates:
+
+  * transpose:<impl>  — linear_transpose of that forward formulation
+                        (what plain/composite impls do today; 'btl4' is
+                        the incumbent, 'xla' is the true-FLOP rank-4
+                        conv dw the 'tlcv' experiment used).
+  * dwe / dweN        — the direct wide GEMM of `_dw_fold`: (dk, dl)
+                        taps folded into x channels, (di, dj) into g
+                        channels, one [kk*kl*cin, ki*kj*cout]
+                        contraction (N-row scan bounds gather memory).
+
+Usage: python benchmarks/micro_dw.py dwe4 dwe2 transpose:btl4 transpose:xla
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from timing import time_chain
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=16,
+                   help="net batch (loss chunk x2 for the symmetric pass)")
+    p.add_argument("--grid", type=int, default=25)
+    p.add_argument("--ksize", type=int, default=5)
+    p.add_argument("--cin", type=int, default=16)
+    p.add_argument("--cout", type=int, default=16)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument(
+        "forms", nargs="*",
+        default=["transpose:btl4", "transpose:xla", "transpose:tlc",
+                 "dwe8", "dwe4", "dwe2", "dwe1"],
+    )
+    args = p.parse_args()
+
+    from ncnet_tpu.ops.conv4d import conv4d, _dw_direct, DW_IMPLS
+
+    b, g, k = args.batch, args.grid, args.ksize
+    cin, cout = args.cin, args.cout
+    dtype = jnp.dtype(args.dtype)
+    rng = np.random.RandomState(0)
+    x0 = jnp.asarray(rng.randn(b, g, g, g, g, cin), dtype)
+    gr = jnp.asarray(rng.randn(b, g, g, g, g, cout), dtype)
+    w0 = jnp.asarray(rng.randn(k, k, k, k, cin, cout) * 1e-2, dtype)
+
+    true_flops = 2.0 * b * g**4 * k**4 * cin * cout
+    print(
+        f"dw [{b},{g}^4] {cin}->{cout} k={k}^4 {dtype.name}: "
+        f"{true_flops / 1e12:.3f} TFLOP true"
+    )
+
+    for form in args.forms:
+        if form.startswith("transpose:"):
+            impl = form.split(":", 1)[1]
+
+            def dw_fn(x, gg, w, impl=impl):
+                tw = jax.linear_transpose(
+                    lambda ww: conv4d(x, ww, impl=impl), w
+                )
+                (dw,) = tw(gg)
+                return dw.astype(jnp.float32)
+
+        else:
+            assert form in DW_IMPLS, form
+
+            def dw_fn(x, gg, w, form=form):
+                return _dw_direct(form, x, gg, w.shape).astype(jnp.float32)
+
+        def make_chain(n, dw_fn=dw_fn):
+            @jax.jit
+            def f(x, gg, w):
+                acc = jnp.zeros(w.shape, jnp.float32)
+                for t in range(n):
+                    # vary g so repeats can't be CSE'd; keep a data dep
+                    # (cast: cotangents must match the primal dtype)
+                    bump = acc[0, 0, 0, 0, 0, 0].astype(gg.dtype)
+                    acc = acc + dw_fn(x, gg + bump, w)
+                return acc
+
+            return f, (x0, gr, w0)
+
+        try:
+            dt = time_chain(make_chain)
+        except Exception as e:
+            print(f"  {form:16s}: FAILED {type(e).__name__}: {str(e)[:110]}")
+            continue
+        print(
+            f"  {form:16s}: {dt * 1e3:8.2f} ms  "
+            f"{true_flops / dt / 1e12:7.2f} TFLOP/s true-rate"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -33,15 +33,41 @@ from jax import lax
 # listed separately by callers that can run interpret mode; CLI surfaces
 # exclude it (it does not lower on TPU — kernels/conv4d_pallas.py STATUS).
 CONV4D_IMPLS = (
-    "xla", "taps", "scan", "tlc", "btl", "btl2", "btl4", "btl5", "tlcv",
+    "xla", "taps", "scan", "tlc", "btl", "btl2", "btl3", "btl4", "btl5",
+    "btl6", "tlcv",
     "tf3", "tf2", "cf", "cfs", "cf1", "cf1s", "ck1", "tk1", "gemm", "gemms",
 )
 
 
+# Direct kernel-gradient (dw) lowerings accepted in the third slot of a
+# composite impl ('<fwd>/<dx>/<dw>'), alongside any forward impl name
+# (which means: linear-transpose THAT formulation wrt w).
+#   'dwe'  — one wide GEMM: (dk, dl) taps folded into x's channel axis,
+#            (di, dj) taps into g's channel axis (memory-hungry: both
+#            operands are ki*kj x the activation size).
+#   'dweN' — the same, scanned over blocks of N rows of the padded
+#            leading dim (N in 1, 2, 4, 8): O(N/I) gather memory.
+DW_IMPLS = ("dwe", "dwe1", "dwe2", "dwe4", "dwe8")
+
+
 def is_valid_impl(name):
-    """True for a registry name or a '<fwd>/<dx>' composite of two."""
+    """True for a registry name, a '<fwd>/<dx>' composite, or a
+    '<fwd>/<dx>/<dw>' composite. In composites the dx and dw slots may be
+    empty ('btl4//dwe4'), meaning: use the autodiff linear transpose of
+    the FORWARD formulation for that input. The dw slot accepts forward
+    impl names (transpose that formulation wrt w) or a DW_IMPLS name."""
     parts = name.split("/")
-    return 1 <= len(parts) <= 2 and all(p in CONV4D_IMPLS for p in parts)
+    if len(parts) == 1:
+        return parts[0] in CONV4D_IMPLS
+    if not 2 <= len(parts) <= 3 or parts[0] not in CONV4D_IMPLS:
+        return False
+    if parts[1] and parts[1] not in CONV4D_IMPLS:
+        return False
+    if len(parts) == 3 and parts[2] and (
+        parts[2] not in CONV4D_IMPLS and parts[2] not in DW_IMPLS
+    ):
+        return False
+    return True
 
 
 def resolve_layer_impls(impl, n_layers):
@@ -54,6 +80,19 @@ def resolve_layer_impls(impl, n_layers):
         raise ValueError(
             f"conv4d impl list {impls} does not match {n_layers} NC layers"
         )
+    # validate names here (not only in the CLI parsers) so a typo in a
+    # programmatically-built config fails with this message instead of
+    # surfacing deep inside jit tracing of the dispatch
+    for name in impls:
+        # 'pallas' is legal at this layer (interpret-mode runs route it
+        # through conv4d_packed); only the CLIs exclude it
+        if name != "pallas" and not is_valid_impl(name):
+            raise ValueError(
+                f"unknown conv4d impl {name!r}: expect a name from "
+                f"{CONV4D_IMPLS}, or '<fwd>/<dx>[/<dw>]' composites of "
+                f"them (dw also accepts {DW_IMPLS}; empty dx/dw slots "
+                "mean 'autodiff transpose of the forward')"
+            )
     return impls
 
 
@@ -843,15 +882,126 @@ def _conv4d_gemms(x, w):
 def _flip_transpose(w):
     """Filters of the conv4d input-gradient identity: spatially flipped,
     in/out channels swapped (stride-1 SAME, odd kernels)."""
+    # the identity only holds for odd kernels under SAME stride-1 padding;
+    # an even kernel would yield silently wrong input gradients (raise, not
+    # assert: input validation must survive python -O)
+    if any(k % 2 == 0 for k in w.shape[:4]):
+        raise ValueError(
+            f"composite conv4d dx requires odd kernel sizes, got {w.shape[:4]}"
+        )
     return jnp.flip(w, axis=(0, 1, 2, 3)).transpose(0, 1, 2, 3, 5, 4)
+
+
+def _dw_fold(x, g, w_shape, block=0):
+    """Direct conv4d kernel gradient as one wide MXU GEMM (or an i-blocked
+    scan of them): ``dw[di,dj,dk,dl,c,o] = sum_{b,i,j,k,l}
+    x[b, i+di-pi, j+dj-pj, k+dk-pk, l+dl-pl, c] * g[b,i,j,k,l,o]``.
+
+    Fold the (dk, dl) taps into x's channel axis and the (di, dj) taps
+    into g's channel axis; the whole gradient is then ONE
+    ``[kk*kl*cin, ki*kj*cout]`` contraction over the zero-extended
+    (b, i, j, k, l) volume — 400x400 full 128-lane MXU tiles at the NC
+    middle layer, with only the (Ip*Jp)/(I*J) ~ 1.35x domain-padding FLOP
+    inflation (vs 1.79x for the blocked-Toeplitz transpose and 5x for the
+    dense one). The cost is gather traffic: both operands materialize at
+    kk*kl (resp. ki*kj) times the activation size, so ``block`` bounds
+    live memory by scanning over `block` rows of the padded leading dim
+    and accumulating the (tiny) fp32 flat gradient.
+
+    Gradient of the op the reference realises as torch autograd through
+    its conv3d loop (lib/conv4d.py:39-48).
+    """
+    ki, kj, kk, kl, cin, cout = w_shape
+    if any(k % 2 == 0 for k in w_shape[:4]):
+        raise ValueError(
+            f"_dw_fold requires odd kernel sizes, got {w_shape[:4]}"
+        )
+    b, I, J, K, L, _ = x.shape
+    pi, pj, pk, pl = ki // 2, kj // 2, kk // 2, kl // 2
+    Ip, Jp = I + 2 * pi, J + 2 * pj
+    # x zero-embedded in (i, j) — the extended contraction domain — and
+    # halo-padded in (k, l) for the window gather.
+    xpad = jnp.pad(
+        x, ((0, 0), (pi, pi), (pj, pj), (pk, pk), (pl, pl), (0, 0))
+    )
+    # g extended by the full shift range in i so per-block (di) row
+    # windows are plain slices of a [s, s + rows + 2*pi) dynamic window.
+    gpad = jnp.pad(g, ((0, 0), (2 * pi, 2 * pi)) + ((0, 0),) * 4)
+
+    def block_dw(s, rows):
+        # xg[q, jp, k, l, (dk, dl, c)] over padded-i rows [s, s+rows);
+        # every slice is reshaped to 5D BEFORE the concat (law 1: >=6D
+        # intermediates draw pathological TPU layouts).
+        xw = lax.dynamic_slice_in_dim(xpad, s, rows, axis=1)
+        xg = jnp.concatenate(
+            [
+                xw[:, :, :, dk : dk + K, dl : dl + L, :].reshape(
+                    b * rows, Jp, K, L, cin
+                )
+                for dk in range(kk)
+                for dl in range(kl)
+            ],
+            axis=-1,
+        )
+        # gg[q, jp, k, l, (di, dj, o)] = g[b, ip - di, jp - dj, k, l, o]
+        # (zero outside): row ip = s + t of shift di is gpad row
+        # s + t + 2*pi - di, and the dj shift is a zero-embed in j.
+        gw = lax.dynamic_slice_in_dim(gpad, s, rows + 2 * pi, axis=1)
+        gg = jnp.concatenate(
+            [
+                jnp.pad(
+                    gw[:, 2 * pi - di : 2 * pi - di + rows],
+                    ((0, 0), (0, 0), (dj, 2 * pj - dj)) + ((0, 0),) * 3,
+                ).reshape(b * rows, Jp, K, L, cout)
+                for di in range(ki)
+                for dj in range(kj)
+            ],
+            axis=-1,
+        )
+        return jnp.einsum(
+            "qjklX,qjklY->XY", xg, gg, preferred_element_type=jnp.float32
+        )
+
+    if block:
+        nb = -(-Ip // block)
+        # round the padded-i domain up to whole blocks; the extra zero
+        # rows contribute nothing to the contraction
+        xpad = jnp.pad(
+            xpad, ((0, 0), (0, nb * block - Ip)) + ((0, 0),) * 4
+        )
+        gpad = jnp.pad(
+            gpad,
+            ((0, 0), (0, nb * block + 2 * pi - gpad.shape[1]))
+            + ((0, 0),) * 4,
+        )
+
+        def body(acc, t):
+            return acc + block_dw(t * block, block), None
+
+        flat, _ = lax.scan(
+            body,
+            jnp.zeros((kk * kl * cin, ki * kj * cout), jnp.float32),
+            jnp.arange(nb),
+        )
+    else:
+        flat = block_dw(0, Ip)
+    dw = flat.reshape(kk, kl, cin, ki, kj, cout).transpose(3, 4, 0, 1, 2, 5)
+    return dw
+
+
+def _dw_direct(dw_impl, x, g, w_shape):
+    """Dispatch a DW_IMPLS name: 'dwe' = one GEMM, 'dweN' = N-row scan."""
+    block = int(dw_impl[3:]) if len(dw_impl) > 3 else 0
+    return _dw_fold(x, g, w_shape, block=block)
 
 
 _COMPOSITE_CACHE = {}
 
 
-def _composite_conv4d(fwd_impl, dx_impl):
-    """conv4d with independent forward and input-gradient lowerings
-    (impl string '<fwd>/<dx>').
+def _composite_conv4d(fwd_impl, dx_impl, dw_impl=""):
+    """conv4d with independent forward, input-gradient and kernel-gradient
+    lowerings (impl string '<fwd>/<dx>' or '<fwd>/<dx>/<dw>'; empty dx/dw
+    slots fall back to the autodiff linear transpose of the forward).
 
     Motivation (round 3, measured): XLA's autodiff transposes a conv in
     the SAME formulation as its forward. For the 16->1 NC layer under
@@ -861,10 +1011,13 @@ def _composite_conv4d(fwd_impl, dx_impl):
     stack f+b). dx is itself a conv4d (flipped/transposed filters), so
     it can use whichever lowering fits ITS channel shape — 'tlc/btl'
     computes the same gradient as a 1->16-shaped 'btl' forward (~15 ms).
-    dw keeps the forward formulation's linear transpose (the tlcv
-    experiment showed swapping dw forms is a loss).
+
+    The dw slot (round 4): a forward impl name transposes THAT
+    formulation wrt w instead of the forward's own; a DW_IMPLS name
+    ('dwe', 'dwe4', ...) computes the kernel gradient directly as the
+    wide tap-folded GEMM of `_dw_fold`.
     """
-    key = (fwd_impl, dx_impl)
+    key = (fwd_impl, dx_impl, dw_impl)
     if key in _COMPOSITE_CACHE:
         return _COMPOSITE_CACHE[key]
 
@@ -877,13 +1030,23 @@ def _composite_conv4d(fwd_impl, dx_impl):
 
     def bwd(res, g):
         x, w = res
-        dx = conv4d(g, _flip_transpose(w).astype(g.dtype), impl=dx_impl)
-        # conv4d is linear in w: transpose the forward formulation
-        # directly (jax.vjp would evaluate and discard an extra primal)
-        transpose_w = jax.linear_transpose(
-            lambda ww: conv4d(x, ww, impl=fwd_impl), w
-        )
-        (dw,) = transpose_w(g)
+        if dx_impl:
+            dx = conv4d(g, _flip_transpose(w).astype(g.dtype), impl=dx_impl)
+        else:
+            # conv4d is linear in x: autodiff transpose of the forward
+            transpose_x = jax.linear_transpose(
+                lambda xx: conv4d(xx, w, impl=fwd_impl), x
+            )
+            (dx,) = transpose_x(g)
+        if dw_impl in DW_IMPLS:
+            dw = _dw_direct(dw_impl, x, g, w.shape).astype(w.dtype)
+        else:
+            # conv4d is linear in w: transpose the chosen formulation
+            # directly (jax.vjp would evaluate and discard an extra primal)
+            transpose_w = jax.linear_transpose(
+                lambda ww: conv4d(x, ww, impl=dw_impl or fwd_impl), w
+            )
+            (dw,) = transpose_w(g)
         return dx, dw
 
     f.defvjp(fwd, bwd)
@@ -901,10 +1064,10 @@ def conv4d(x, w, bias=None, impl="xla", interpret=None):
         semantics, lib/conv4d.py:41-48).
       impl: 'xla' (one rank-4 conv HLO) | 'taps' (per-tap conv3d sum) |
         'scan' (sequential over i, minimal memory) | 'tlc' (Toeplitz-l
-        conv3d, 5x FLOPs but wide lanes) | 'btl'/'btl2'/'btl4'/'btl5'
-        (blocked Toeplitz-l at block 8/2/4/5: lower FLOP inflation,
-        narrower lanes; block 4 is the measured sweet spot for the
-        16->16 middle NC layer) | 'tlcv' (tlc forward + custom
+        conv3d, 5x FLOPs but wide lanes) | 'btl'/'btl2'/'btl3'/'btl4'/
+        'btl5'/'btl6' (blocked Toeplitz-l at block 8/2/3/4/5/6: lower
+        FLOP inflation, narrower lanes; block 4 is the measured sweet
+        spot for the 16->16 middle NC layer) | 'tlcv' (tlc forward + custom
         VJP with a true-FLOP rank-4 kernel gradient — measured SLOWER
         end-to-end than tlc, kept as a documented negative result) |
         'tf3'/'tf2' (taps folded into
@@ -937,10 +1100,12 @@ def conv4d(x, w, bias=None, impl="xla", interpret=None):
         if not is_valid_impl(impl):
             raise ValueError(
                 f"invalid composite conv4d impl {impl!r} (expect "
-                "'<fwd>/<dx>' with both names from CONV4D_IMPLS)"
+                "'<fwd>/<dx>' or '<fwd>/<dx>/<dw>' with names from "
+                "CONV4D_IMPLS — dw also accepts DW_IMPLS; dx/dw may be "
+                "empty meaning 'autodiff transpose of the forward')"
             )
-        fwd_impl, dx_impl = impl.split("/")
-        out = _composite_conv4d(fwd_impl, dx_impl)(x, w)
+        parts = impl.split("/")
+        out = _composite_conv4d(*parts)(x, w)
         if bias is not None:
             out = out + bias
         return out
@@ -954,12 +1119,8 @@ def conv4d(x, w, bias=None, impl="xla", interpret=None):
         out = _conv4d_tlc(x, w)
     elif impl == "btl":
         out = _conv4d_btl(x, w)
-    elif impl == "btl4":
-        out = _conv4d_btl(x, w, block=4)
-    elif impl == "btl2":
-        out = _conv4d_btl(x, w, block=2)
-    elif impl == "btl5":
-        out = _conv4d_btl(x, w, block=5)
+    elif impl in CONV4D_IMPLS and impl.startswith("btl") and impl[3:].isdigit():
+        out = _conv4d_btl(x, w, block=int(impl[3:]))
     elif impl == "tlcv":
         out = _conv4d_tlcv(x, w)
     elif impl == "tf3":

@@ -96,21 +96,23 @@ def corr_to_matches(
     return x_a, y_a, x_b, y_b, score
 
 
-def _bilinear_transfer_single(x_a, y_a, x_b, y_b, target_points, feature_size):
-    grid = jnp.linspace(-1.0, 1.0, feature_size, dtype=x_a.dtype)
+def _bilinear_transfer_single(x_a, y_a, x_b, y_b, target_points, grid_shape):
+    h, w = grid_shape
+    grid_x = jnp.linspace(-1.0, 1.0, w, dtype=x_a.dtype)
+    grid_y = jnp.linspace(-1.0, 1.0, h, dtype=x_a.dtype)
     tx, ty = target_points[0], target_points[1]  # [Np]
 
-    def lower_idx(coord):
+    def lower_idx(coord, grid, n):
         cnt = jnp.sum(coord[None, :] > grid[:, None], axis=0) - 1
-        return jnp.clip(cnt, 0, feature_size - 2)
+        return jnp.clip(cnt, 0, n - 2)
 
-    x_minus = lower_idx(tx)
-    y_minus = lower_idx(ty)
+    x_minus = lower_idx(tx, grid_x, w)
+    y_minus = lower_idx(ty, grid_y, h)
     x_plus = x_minus + 1
     y_plus = y_minus + 1
 
     def to_idx(xi, yi):
-        return yi * feature_size + xi
+        return yi * w + xi
 
     def p_at(idx):  # matched-grid (B) corner coordinates
         return jnp.stack([x_b[idx], y_b[idx]])
@@ -140,25 +142,37 @@ def _bilinear_transfer_single(x_a, y_a, x_b, y_b, target_points, feature_size):
     return num / (f_pp + f_mm + f_mp + f_pm)
 
 
-def bilinear_point_transfer(matches, target_points_norm):
+def bilinear_point_transfer(matches, target_points_norm, grid_shape=None):
     """Warp target keypoints into the source image via the match grid.
 
     Args:
       matches: ``(xA, yA, xB, yB)`` from `corr_to_matches` in the default
-        (B->A) direction, each ``[b, N]`` with N a square grid.
+        (B->A) direction, each ``[b, N]`` with ``N = h*w`` match-grid
+        cells in row-major order (the reference hardcodes the square case
+        via ``int(sqrt(N))``, lib/point_tnf.py:104).
       target_points_norm: ``[b, 2, Np]`` in [-1, 1].
+      grid_shape: the ``(h, w)`` of the match grid. Default: inferred as
+        square from N; REQUIRED for rectangular eval grids (e.g. a
+        non-square `corr_to_matches` `output_size`).
 
     Returns:
       ``[b, 2, Np]`` warped points in [-1, 1] (source-image frame).
     """
     x_a, y_a, x_b, y_b = matches
     n = x_b.shape[-1]
-    feature_size = int(round(n**0.5))
-    if feature_size * feature_size != n:
-        raise ValueError(f"match grid is not square: N={n}")
+    if grid_shape is None:
+        side = int(round(n**0.5))
+        if side * side != n:
+            raise ValueError(
+                f"match grid is not square: N={n}; pass grid_shape=(h, w) "
+                "matching the correlation output_size"
+            )
+        grid_shape = (side, side)
+    if grid_shape[0] * grid_shape[1] != n:
+        raise ValueError(f"grid_shape {grid_shape} does not factor N={n}")
     return jax.vmap(
         lambda a, b_, c, d, t: _bilinear_transfer_single(
-            a, b_, c, d, t, feature_size
+            a, b_, c, d, t, grid_shape
         )
     )(x_a, y_a, x_b, y_b, target_points_norm)
 

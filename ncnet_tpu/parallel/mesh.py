@@ -7,6 +7,8 @@ correlation tensor over its (iA, jA) dims (the long-context analog; see
 `ncnet_tpu.parallel.spatial`). Collectives ride ICI/DCN via XLA.
 """
 
+import os
+
 import numpy as np
 
 import jax
@@ -36,11 +38,25 @@ def initialize_multihost(coordinator_address=None, num_processes=None,
     With explicit arguments, initialization failures raise. With
     auto-detection, the expected no-cluster case falls back to single-host
     WITH a visible log line (a silent fallback on a real pod would leave
-    every host training its own divergent model).
+    every host training its own divergent model). To turn that hazard into
+    a hard failure on a real deployment, set ``NCNET_REQUIRE_MULTIHOST``:
+    ``N >= 2`` requires at least N processes; ``1`` or any non-numeric
+    truthy value requires a real multi-host runtime (> 1 process); ``0``
+    or unset disables the guard. Auto-detection that falls back or lands
+    below the expectation then raises instead of printing.
 
     Returns ``(process_index, process_count)`` for per-host data feeding
     (`data.loader.DataLoader(host_id=..., n_hosts=...)`).
     """
+    require = os.environ.get("NCNET_REQUIRE_MULTIHOST", "")
+    # '' / '0' disable the guard; '1' and non-numeric truthy values mean
+    # "enabled, require a real multi-host runtime (>1)"; N>=2 requires N
+    if require in ("", "0"):
+        require_n = 0
+    elif require.isdigit():
+        require_n = max(int(require), 2)
+    else:
+        require_n = 2
     explicit = coordinator_address is not None or num_processes is not None
     try:
         if explicit:
@@ -54,10 +70,23 @@ def initialize_multihost(coordinator_address=None, num_processes=None,
     except Exception as e:  # noqa: BLE001 — explicit path re-raises
         if explicit:
             raise
+        if require_n:
+            raise RuntimeError(
+                "initialize_multihost: auto-detection failed but "
+                f"NCNET_REQUIRE_MULTIHOST={require!r} is set — refusing "
+                "the single-host fallback (every host would silently "
+                "train its own divergent model)"
+            ) from e
         print(
             "initialize_multihost: single-host fallback "
             f"({type(e).__name__}: {e})",
             flush=True,
+        )
+    if require_n and jax.process_count() < require_n:
+        raise RuntimeError(
+            f"initialize_multihost: joined a {jax.process_count()}-process "
+            f"runtime but NCNET_REQUIRE_MULTIHOST={require!r} expects "
+            f">= {require_n}"
         )
     return jax.process_index(), jax.process_count()
 

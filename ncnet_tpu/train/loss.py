@@ -57,9 +57,14 @@ def weak_loss(params, config, batch, normalization="softmax"):
     `lax.map`, rematerialized per chunk when ``config.loss_chunk_remat``
     (default True): peak memory for the big 4D tensors then scales with
     the chunk, not the batch (with it off, `lax.map` stacks residuals
-    across chunks and memory scales with the batch again). Identical
-    math — the rolled-negative pairing is fixed on the full batch of
-    features BEFORE chunking, and all scores are per-sample means.
+    across chunks and memory scales with the batch again). When
+    ``loss_chunk >= batch`` the single covering chunk applies the same
+    'nc_conv'-saving checkpoint WITHOUT the `lax.map` loop (identical
+    math to the unchunked path, but the remat memory/speed profile —
+    set ``loss_chunk_remat=False`` for the plain no-remat path).
+    Identical math throughout — the rolled-negative pairing is fixed on
+    the full batch of features BEFORE chunking, and all scores are
+    per-sample means.
     """
     if config.relocalization_k_size > 1:
         raise ValueError(

@@ -34,7 +34,8 @@ def _conv4d_impl_arg(value):
             raise argparse.ArgumentTypeError(
                 f"unknown conv4d impl {name!r} (choose from "
                 f"{', '.join(CONV4D_IMPLS)}; comma-separate for per-layer; "
-                "'<fwd>/<dx>' composes forward and input-grad lowerings)"
+                "'<fwd>/<dx>[/<dw>]' composes forward/input-grad/"
+                "kernel-grad lowerings)"
             )
     return value
 
@@ -82,10 +83,15 @@ def main():
     # here would crash mid-training on the target hardware.
     p.add_argument("--conv4d_impl", type=_conv4d_impl_arg, default=None,
                    help="conv4d lowering, one name or a comma-separated "
-                        "per-NC-layer list ('<fwd>/<dx>' composes forward "
-                        "and input-grad lowerings). Default: the measured-"
-                        "best mix 'tlc,btl4,tlc/tlc' for 3-layer NC "
-                        "configs, 'tlc' otherwise (see ops/conv4d.py)")
+                        "per-NC-layer list ('<fwd>/<dx>[/<dw>]' composes "
+                        "forward/input-grad/kernel-grad lowerings). "
+                        "RECOMMENDED (measured, benchmarks/PERF.md): the "
+                        "default per-layer mix, or 'tlc' / 'btl4' / their "
+                        "composites uniformly. The remaining registry "
+                        "names (cf1, cf1s, gemms, tlcv, ...) are kept as "
+                        "measured NEGATIVE results — valid but slower on "
+                        "TPU. Default: the measured-best mix for 3-layer "
+                        "NC configs, 'tlc' otherwise (see ops/conv4d.py)")
     p.add_argument("--loss_chunk", type=int, default=None,
                    help="run the correlation->NC->score loss over sample "
                         "chunks of this size (0 = whole batch; when "

@@ -153,3 +153,74 @@ def test_composite_impl_grads_match_xla():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(bb), rtol=1e-3, atol=1e-4
         )
+
+
+@pytest.mark.parametrize(
+    "impl",
+    [
+        "btl4//dwe",     # empty dx (autodiff transpose) + direct-GEMM dw
+        "btl4//dwe2",    # blocked-scan direct dw
+        "tlc/btl/dwe4",
+        "tlc//btl",      # dw via transpose of ANOTHER formulation
+        "tlc/tlc/tf3",   # the round-4 measured-best L3 combination
+        "btl4/btl4/dwe1",
+    ],
+)
+def test_three_way_composite_grads_match_xla(impl):
+    """'<fwd>/<dx>/<dw>' composites (round 4): the dw slot may transpose a
+    different formulation or compute the kernel gradient directly via the
+    tap-folded GEMM of `_dw_fold`; values and ALL grads must match
+    autodiff through the rank-4 conv."""
+    rng = np.random.RandomState(13)
+    x = jnp.asarray(rng.randn(2, 4, 5, 4, 5, 2).astype(np.float32))
+    w = jnp.asarray(rng.randn(3, 3, 3, 3, 2, 3).astype(np.float32))
+    b = jnp.asarray(rng.randn(3).astype(np.float32))
+
+    f_xla = lambda x_, w_, b_: jnp.sum(jnp.sin(conv4d(x_, w_, b_, impl="xla")))
+    f_cmp = lambda x_, w_, b_: jnp.sum(jnp.sin(conv4d(x_, w_, b_, impl=impl)))
+    np.testing.assert_allclose(f_xla(x, w, b), f_cmp(x, w, b), rtol=1e-5)
+    g_xla = jax.grad(f_xla, argnums=(0, 1, 2))(x, w, b)
+    g_cmp = jax.grad(f_cmp, argnums=(0, 1, 2))(x, w, b)
+    for a, bb in zip(g_xla, g_cmp):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(bb), rtol=1e-3, atol=1e-4
+        )
+
+
+def test_dw_fold_blocked_matches_unblocked_and_autodiff():
+    """`_dw_fold` (the direct tap-folded kernel-gradient GEMM): every block
+    size agrees with the single-GEMM path and with autodiff, including
+    rectangular grids and a 5^4 kernel."""
+    from ncnet_tpu.ops.conv4d import _dw_fold
+
+    rng = np.random.RandomState(17)
+    for shape, ks in [((2, 5, 6, 4, 5, 3), 3), ((1, 6, 6, 6, 6, 1), 5)]:
+        cin, cout = shape[-1], 2
+        x = jnp.asarray(rng.randn(*shape).astype(np.float32))
+        w = jnp.asarray(rng.randn(ks, ks, ks, ks, cin, cout).astype(np.float32))
+        g = jnp.asarray(rng.randn(*shape[:-1], cout).astype(np.float32))
+        dw_ref = jax.grad(
+            lambda w_: jnp.vdot(conv4d(x, w_, impl="xla"), g)
+        )(w)
+        for block in (0, 1, 2, 4):
+            dw = _dw_fold(x, g, w.shape, block=block)
+            np.testing.assert_allclose(
+                np.asarray(dw), np.asarray(dw_ref), rtol=1e-4, atol=1e-4,
+                err_msg=f"block={block}",
+            )
+
+
+def test_composite_even_kernel_raises():
+    """Even kernels break the flip/transpose dx identity and the _dw_fold
+    contraction domain: both must fail loudly, not return wrong grads."""
+    rng = np.random.RandomState(19)
+    x = jnp.asarray(rng.randn(1, 4, 4, 4, 4, 2).astype(np.float32))
+    w_even = jnp.asarray(rng.randn(2, 2, 2, 2, 2, 2).astype(np.float32))
+    g = jnp.asarray(rng.randn(1, 4, 4, 4, 4, 2).astype(np.float32))
+    f = lambda x_, w_: jnp.sum(conv4d(x_, w_, impl="tlc/tlc"))
+    with pytest.raises(ValueError, match="odd kernel"):
+        jax.grad(f, argnums=1)(x, w_even)
+    from ncnet_tpu.ops.conv4d import _dw_fold
+
+    with pytest.raises(ValueError, match="odd kernel"):
+        _dw_fold(x, g, w_even.shape)

@@ -1,5 +1,6 @@
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from ncnet_tpu.ops.coords import (
     normalize_axis,
@@ -114,6 +115,48 @@ def test_bilinear_point_transfer_affine():
     )
     np.testing.assert_allclose(warped[0, 0], 0.5 * pts[0, 0] + 0.1, rtol=1e-5)
     np.testing.assert_allclose(warped[0, 1], -0.25 * pts[0, 1], rtol=1e-5, atol=1e-6)
+
+
+def rect_identity_matches(h, w, b=1):
+    lx = np.linspace(-1, 1, w).astype(np.float32)
+    ly = np.linspace(-1, 1, h).astype(np.float32)
+    xb = np.tile(lx, h)[None].repeat(b, 0)
+    yb = np.repeat(ly, w)[None].repeat(b, 0)
+    return xb.copy(), yb.copy(), xb, yb
+
+
+def test_bilinear_point_transfer_rectangular_grid():
+    # non-square match grid (h != w): requires an explicit grid_shape,
+    # then behaves exactly like the square path
+    h, w = 4, 7
+    xb, yb, _, _ = rect_identity_matches(h, w)
+    xa = 0.5 * xb + 0.1
+    ya = -0.25 * yb
+    pts = np.array([[[-0.4, 0.3, 0.8], [0.6, -0.2, -0.7]]], np.float32)
+    args = tuple(map(jnp.asarray, (xa, ya, xb, yb)))
+    with pytest.raises(ValueError, match="grid_shape"):
+        bilinear_point_transfer(args, jnp.asarray(pts))
+    with pytest.raises(ValueError, match="does not factor"):
+        bilinear_point_transfer(args, jnp.asarray(pts), grid_shape=(5, 5))
+    warped = np.asarray(
+        bilinear_point_transfer(args, jnp.asarray(pts), grid_shape=(h, w))
+    )
+    np.testing.assert_allclose(warped[0, 0], 0.5 * pts[0, 0] + 0.1, rtol=1e-5)
+    np.testing.assert_allclose(
+        warped[0, 1], -0.25 * pts[0, 1], rtol=1e-5, atol=1e-6
+    )
+
+
+def test_bilinear_point_transfer_square_explicit_shape_matches_default():
+    fs = 5
+    xa, ya, xb, yb = identity_matches(fs)
+    pts = np.array([[[-0.3, 0.55], [0.2, -0.8]]], np.float32)
+    args = tuple(map(jnp.asarray, (xa, ya, xb, yb)))
+    default = bilinear_point_transfer(args, jnp.asarray(pts))
+    explicit = bilinear_point_transfer(
+        args, jnp.asarray(pts), grid_shape=(fs, fs)
+    )
+    np.testing.assert_array_equal(np.asarray(default), np.asarray(explicit))
 
 
 def test_nearest_point_transfer():

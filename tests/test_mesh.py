@@ -28,6 +28,31 @@ def test_initialize_multihost_single_process_noop():
     assert (pid, n) == (0, 1)
 
 
+def test_initialize_multihost_require_env_hard_fails(monkeypatch):
+    # NCNET_REQUIRE_MULTIHOST turns the silent single-host fallback into
+    # an error: on a real pod a broken auto-detection would otherwise
+    # leave every host training its own divergent model.
+    import pytest
+
+    monkeypatch.setenv("NCNET_REQUIRE_MULTIHOST", "4")
+    with pytest.raises(RuntimeError, match="NCNET_REQUIRE_MULTIHOST"):
+        initialize_multihost()
+    # non-numeric truthy value requires merely >1 process
+    monkeypatch.setenv("NCNET_REQUIRE_MULTIHOST", "yes")
+    with pytest.raises(RuntimeError, match="NCNET_REQUIRE_MULTIHOST"):
+        initialize_multihost()
+    # '1' means "guard enabled" (boolean convention), not "1 process ok"
+    monkeypatch.setenv("NCNET_REQUIRE_MULTIHOST", "1")
+    with pytest.raises(RuntimeError, match="NCNET_REQUIRE_MULTIHOST"):
+        initialize_multihost()
+    # '0' disables like unset
+    monkeypatch.setenv("NCNET_REQUIRE_MULTIHOST", "0")
+    assert initialize_multihost() == (0, 1)
+    # unset -> single-host fallback stays a no-op
+    monkeypatch.delenv("NCNET_REQUIRE_MULTIHOST")
+    assert initialize_multihost() == (0, 1)
+
+
 def test_shard_and_replicate_roundtrip():
     mesh = make_mesh()
     batch = {"x": np.arange(16, dtype=np.float32).reshape(8, 2)}

@@ -33,6 +33,46 @@ if not __import__("os").path.isdir(f"{REF_ROOT}/lib"):
         allow_module_level=True,
     )
 
+# The checkout is PUBLIC UNTRUSTED CONTENT and importing it executes its
+# module bodies — so the exact files this module imports (or exec's, for
+# train.py's weak_loss) are pinned by content hash, and a mismatch skips
+# the whole module instead of running unvetted code at collection time.
+# Set NCNET_ORACLE_UNPINNED=1 to run against a changed checkout anyway
+# (e.g. after auditing a legitimately updated reference).
+_PINNED_SHA256 = {
+    "lib/conv4d.py":
+        "7492575a0a52ed2bd86732c54a39751020dd96e5d3dcf303c401a74d3e624f6b",
+    "lib/model.py":
+        "62d881cbeaa3ef820a9c119ad12ea0f83a5a9732a3db34950fa1fe28cbbd79c7",
+    "lib/point_tnf.py":
+        "2f65ef4a1a83181a0727e4b51dfa20d9c909a24157285ff3e54a62bbb29cae27",
+    "lib/eval_util.py":
+        "37cbfbfacea529774c1ce432cb25f54f1230984ba115b15a902ab35c1fbad1e1",
+    "train.py":
+        "d461e082e32bcc71edc1c71b376a06b0407623d3d461078385e37bc929005c8b",
+}
+
+if __import__("os").environ.get("NCNET_ORACLE_UNPINNED", "") != "1":
+    import hashlib
+
+    def _differs(rel, want):
+        try:
+            with open(f"{REF_ROOT}/{rel}", "rb") as f:
+                return hashlib.sha256(f.read()).hexdigest() != want
+        except OSError:  # missing file = changed checkout -> skip, not error
+            return True
+
+    _changed = [
+        rel for rel, want in _PINNED_SHA256.items() if _differs(rel, want)
+    ]
+    if _changed:
+        pytest.skip(
+            f"reference files {_changed} differ from the pinned hashes — "
+            "refusing to import/exec an unvetted checkout (set "
+            "NCNET_ORACLE_UNPINNED=1 after auditing it)",
+            allow_module_level=True,
+        )
+
 # All conv4d lowerings that run on the CPU test platform.
 CONV4D_IMPLS = [
     "xla", "taps", "scan", "tlc", "btl", "tlcv", "tf3", "tf2",
