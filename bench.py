@@ -31,9 +31,16 @@ A Pallas kernel cannot beat this either: Mosaic requires 8-aligned
 sublane offsets, but conv4d row shifts have granularity 1 in the fused
 (j,k) dims, forcing the same banded/inflated formulations (>=3.2x
 effective with K/N pads) that XLA already runs at 70% peak.
-Best known config (16.0 pairs/s, 14.1% MFU, vs_baseline 4.0): PER-LAYER
-impl mixing 'tlc,btl4,tlc/tlc' + loss_chunk 8 + 'nc_conv' save-policy
-remat. The middle 16->16 layer (89% of stack FLOPs) uses the 5D-safe
+Best known config (16.17 pairs/s, 14.2% MFU, vs_baseline 4.04): PER-LAYER
+impl mixing 'tlc//btl,btl4,tlc/tlc/tf3' + loss_chunk 8 + 'nc_conv'
+save-policy remat — round 4 adds the dw (kernel-gradient) slot: the edge
+layers' dw transposes a DIFFERENT formulation than their forward ('btl'
+for 1->16: 22.4 ms vs tlc's 24.8; 'tf3' for 16->1: 13.2 ms vs 18.3),
+while the middle layer keeps btl4's own transpose (39.7 ms — every
+measured alternative loses: tlc 83.7, cf 113.7, btl5 42.9, rank-4 'xla'
+174.2, and the direct tap-folded GEMM 'dwe*' forms are gather-bound at
+450-1150 ms). Block re-sweep under this regime: btl3 15.3, btl4 16.17,
+btl5 14.3, btl6 13.1 pairs/s — block 4 stays the sweet spot. The middle 16->16 layer (89% of stack FLOPs) uses the 5D-safe
 blocked Toeplitz at block 4 (1.79x inflation, the measured sweet spot:
 block 2 = 14.0 pairs/s end-to-end, block 5 = 14.0, block 8 = 14.6, dense
 'tlc' = 11.9); the 1-channel edge layers keep the dense Toeplitz
@@ -94,7 +101,7 @@ def train_step_flops(batch, grid=25, feat_ch=1024, image=400):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--conv4d_impl", default="tlc,btl4,tlc/tlc",
+    p.add_argument("--conv4d_impl", default="tlc//btl,btl4,tlc/tlc/tf3",
                    help="one impl or a comma-separated per-NC-layer list; "
                         "'<fwd>/<dx>' composes forward and input-grad "
                         "lowerings (measured-best default)")

@@ -1,0 +1,93 @@
+"""Steady-state InLoc dump characterization (VERDICT r3 weak #2).
+
+Times the full per-pair match function (`eval.inloc.make_match_fn`: trunk
+x2 -> fused correlation+maxpool4d -> MM -> NC -> MM -> both-direction
+corr_to_matches) at the REAL InLoc shape bucket on one chip, per conv4d
+impl, separating compile time from steady state. The resize-rule census
+(see PERF.md) puts EVERY real InLoc image (4032x3024 queries, 1600x1200
+cutouts) in the single bucket (2400, 3200) -> 150x200 feature grid ->
+75x100 pooled grid at k=2, so one compile serves the whole 356x10 dump.
+
+Eval is forward-only: impls compete on forward cost + memory only (the
+training winners' dx/dw slots are irrelevant here, and l-dense 'tlc' is
+hopeless at l=100 where its Toeplitz inflation is l/kl = 20x).
+
+Usage: python benchmarks/micro_inloc.py [--impls xla scan btl4 ...]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--height", type=int, default=2400)
+    p.add_argument("--width", type=int, default=3200)
+    p.add_argument("--k_size", type=int, default=2)
+    p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--impls", nargs="*",
+                   default=["cfs", "btl4", "scan"])
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from ncnet_tpu.eval.inloc import make_match_fn
+    from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+
+    rng = np.random.RandomState(0)
+    src = jnp.asarray(rng.rand(1, args.height, args.width, 3), jnp.float32)
+    tgt = jnp.asarray(rng.rand(1, args.height, args.width, 3), jnp.float32)
+
+    for impl in args.impls:
+        config = ImMatchNetConfig(
+            ncons_kernel_sizes=(5, 5, 5),
+            ncons_channels=(16, 16, 1),
+            half_precision=True,
+            relocalization_k_size=args.k_size,
+            conv4d_impl=impl,
+            symmetric_batch=False,
+        )
+        params = init_immatchnet(jax.random.PRNGKey(0), config)
+        fn = jax.jit(make_match_fn(config))
+
+        def sync(out):
+            # D2H forces execution on this platform (block_until_ready
+            # does not); pull one score scalar
+            return float(np.asarray(out[0][4])[0, 0])
+
+        try:
+            t0 = time.perf_counter()
+            sync(fn(params, src, tgt))
+            compile_s = time.perf_counter() - t0
+            steady = []
+            for i in range(args.iters):
+                # vary the input so no caching; same shapes -> no recompile
+                t0 = time.perf_counter()
+                sync(fn(params, src + float(i + 1), tgt))
+                steady.append(time.perf_counter() - t0)
+        except Exception as e:  # noqa: BLE001 — record OOMs as data
+            print(json.dumps({
+                "impl": impl,
+                "error": f"{type(e).__name__}: {str(e)[:160]}",
+            }), flush=True)
+            continue
+        best = min(steady)
+        print(json.dumps({
+            "impl": impl,
+            "shape": [args.height, args.width],
+            "compile_s": round(compile_s, 1),
+            "steady_pair_s": round(best, 2),
+            "projected_356x10_dump_h": round(356 * 10 * best / 3600, 2),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,13 @@ def main():
     p.add_argument("--pano_path", type=str, default="datasets/inloc/pano/")
     p.add_argument("--query_path", type=str, default="datasets/inloc/query/iphone7/")
     p.add_argument("--output_root", type=str, default="matches")
+    p.add_argument("--conv4d_impl", type=str, default="cfs",
+                   help="conv4d lowering for the eval forward (overrides "
+                        "the checkpoint's training-time choice, which is "
+                        "tuned for the 25x25 training grid; 'cfs' is the "
+                        "measured-best at InLoc grids: 0.92 s/pair vs "
+                        "btl4 2.55, scan 14.6 — see "
+                        "benchmarks/micro_inloc.py)")
     p.add_argument("--spatial_shards", type=int, default=0,
                    help="shard the correlation pipeline over this many "
                         "devices ('spatial' mesh axis) for grids beyond "
@@ -48,9 +55,17 @@ def main():
         config, params = ck.config, ck.params
 
     # bf16 + relocalization: the memory toolkit of the reference eval
-    # (fp16 + maxpool4d, eval_inloc.py:50,32), TPU-native.
+    # (fp16 + maxpool4d, eval_inloc.py:50,32), TPU-native. The conv4d
+    # impl is OVERRIDDEN for eval: checkpoints carry the training-grid
+    # (l=25) winner, whose dense-Toeplitz edge layers inflate FLOPs by
+    # l/kl = 20x at InLoc's l=100 pooled grid. 'cfs' (true FLOPs, wide
+    # lanes, scanned) measures 0.92 s/pair steady-state at (2400, 3200)
+    # k=2 vs btl4 2.55 and 'scan' 14.6; 'xla'/'tf3'/'btl2'/'btl6' fail
+    # to compile at this shape (benchmarks/micro_inloc.py).
     config = config.replace(
-        half_precision=True, relocalization_k_size=args.k_size
+        half_precision=True,
+        relocalization_k_size=args.k_size,
+        conv4d_impl=args.conv4d_impl,
     )
 
     exp = os.path.basename(args.inloc_shortlist).split(".")[0]

@@ -225,6 +225,8 @@ def main():
         names, pos_err, ori_err = [], [], []
         for list_name, floor in (("DUC1_RefList", "DUC1"),
                                  ("DUC2_RefList", "DUC2")):
+            if list_name not in gt:  # single-floor GT files are legal
+                continue
             for rec in np.atleast_1d(gt[list_name]):
                 qname = str(rec["queryname"])
                 match = next(

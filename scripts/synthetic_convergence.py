@@ -89,6 +89,10 @@ def run(image_size=128, steps=400, batch=8, n_pairs=32, lr=5e-3, seed=0,
         "losses": losses,
         "pck_before": pck_before,
         "pck_after": pck_after,
+        # trained params + config so downstream synthetic end-to-end
+        # proofs (scripts/synthetic_inloc_e2e.py) can reuse the model
+        "params": state.params,
+        "config": config,
     }
 
 

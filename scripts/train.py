@@ -104,7 +104,7 @@ def main():
     def default_impl(n_layers):
         # per-layer defaults must match the NC layer count (checkpoints
         # carry their own architecture; an explicit flag always wins)
-        return "tlc,btl4,tlc/tlc" if n_layers == 3 else "tlc"
+        return "tlc//btl,btl4,tlc/tlc/tf3" if n_layers == 3 else "tlc"
 
     host_id, n_hosts = 0, 1
     if args.multihost:

@@ -1,0 +1,97 @@
+"""bf16 vs fp32 score numerics around hard thresholds (VERDICT r3 #4).
+
+The reference's MATLAB stage hard-thresholds match scores at 0.75
+(lib_matlab/parfor_NC4D_PE_pnponly.m:16-18) on scores produced by its
+fp16 eval pipeline (eval_inloc.py:50). This repo's eval runs bf16
+(half_precision=True); these tests bound how far bf16 moves the scores
+and how many matches a HARD threshold can flip relative to the fp32
+pipeline — on the same pairs through the same full model forward
+(trunk -> correlation+maxpool4d -> MM -> NC -> MM -> corr_to_matches).
+
+These are the fast numerics checks; the downstream whole-chain proof
+(trained model -> dump -> PnP -> densePV -> rate curve) lives in the
+slow-gated synthetic end-to-end InLoc path (scripts/synthetic_inloc_e2e.py
+and its test).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.data.images import normalize_image_np, resize_bilinear_np
+from ncnet_tpu.models.immatchnet import (
+    ImMatchNetConfig,
+    immatchnet_apply,
+    init_immatchnet,
+)
+from ncnet_tpu.ops.matches import corr_to_matches
+
+
+def _pair(seed=5, size=128, off=32):
+    rng = np.random.RandomState(seed)
+    base = rng.rand(size // 4 + size // 32, size // 4 + size // 32, 3)
+    T = resize_bilinear_np(
+        base.astype(np.float32) * 255.0, size + off, size + off
+    )
+    cut, qry = T[:size, :size], T[off:, off:]
+    prep = lambda im: jnp.asarray(normalize_image_np(im)[None])
+    return prep(qry), prep(cut)
+
+
+def _scores(half_precision, k_size=2):
+    config = ImMatchNetConfig(
+        ncons_kernel_sizes=(3, 3),
+        ncons_channels=(16, 1),
+        half_precision=half_precision,
+        relocalization_k_size=k_size,
+        center_features=True,
+        symmetric_batch=False,
+    )
+    params = init_immatchnet(jax.random.PRNGKey(0), config)
+    src, tgt = _pair()
+    corr, delta4d = immatchnet_apply(params, config, src, tgt)
+    out = []
+    for invert in (False, True):
+        m = corr_to_matches(
+            corr, delta4d=delta4d, k_size=k_size, do_softmax=True,
+            scale="positive", invert_matching_direction=invert,
+        )
+        out.append(np.asarray(m[4])[0])
+    return np.concatenate(out)
+
+
+def test_bf16_scores_match_fp32_within_tolerance():
+    s32 = _scores(False)
+    s16 = _scores(True)
+    assert s32.shape == s16.shape
+    # absolute score movement: softmax scores live in [0, 1]; bf16's ~3
+    # significand digits land well inside the gap any sane threshold
+    # margin has
+    max_abs = float(np.max(np.abs(s32 - s16)))
+    assert max_abs < 0.02, max_abs
+
+
+def test_bf16_threshold_selection_stable_across_sweep():
+    """A hard score threshold selects (almost) the same match set under
+    bf16 as under fp32: any flip must sit within the numerics tolerance
+    of the threshold itself — including at the reference's 0.75."""
+    s32 = _scores(False)
+    s16 = _scores(True)
+    tol = 0.02
+    thresholds = list(np.quantile(s32, [0.1, 0.25, 0.5, 0.75, 0.9]))
+    thresholds.append(0.75)  # the reference's hard threshold
+    for thr in thresholds:
+        sel32 = s32 > thr
+        sel16 = s16 > thr
+        flipped = sel32 != sel16
+        # every flipped match must be a borderline score, not a gross move
+        assert np.all(np.abs(s32[flipped] - thr) < tol), (
+            thr, s32[flipped]
+        )
+        # and flips must be rare relative to the selection size
+        n_sel = max(int(sel32.sum()), 1)
+        assert int(flipped.sum()) <= max(2, 0.05 * n_sel), (
+            thr, int(flipped.sum()), n_sel
+        )
