@@ -1,0 +1,106 @@
+"""Microbenchmark: conv4d INPUT-gradient (dx) formulations in isolation.
+
+Companion to micro_dw.py (round 4). Candidates per NC-layer shape:
+
+  * transpose:<impl> — jax.linear_transpose of that forward formulation
+                       wrt x (what a plain impl's autodiff does);
+  * explicit:<impl>  — dx computed as a forward conv4d of the cotangent
+                       with flipped/channel-transposed filters in that
+                       lowering (what the '<fwd>/<dx>' composites do;
+                       note the channel shape REVERSES: a 16->1 layer's
+                       dx is a 1->16-shaped conv).
+
+Usage: python benchmarks/micro_dx.py --cin 16 --cout 1 transpose:tlc explicit:tlc
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from timing import time_chain
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--grid", type=int, default=25)
+    p.add_argument("--ksize", type=int, default=5)
+    p.add_argument("--cin", type=int, default=16)
+    p.add_argument("--cout", type=int, default=16)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument(
+        "forms", nargs="*",
+        default=["transpose:btl4", "explicit:btl4", "explicit:tlc",
+                 "explicit:tf3", "explicit:cf", "transpose:tlc"],
+    )
+    args = p.parse_args()
+
+    from ncnet_tpu.ops.conv4d import conv4d, _flip_transpose
+
+    b, g, k = args.batch, args.grid, args.ksize
+    cin, cout = args.cin, args.cout
+    dtype = jnp.dtype(args.dtype)
+    rng = np.random.RandomState(0)
+    x0 = jnp.asarray(rng.randn(b, g, g, g, g, cin), dtype)
+    gr = jnp.asarray(rng.randn(b, g, g, g, g, cout), dtype)
+    w0 = jnp.asarray(rng.randn(k, k, k, k, cin, cout) * 1e-2, dtype)
+
+    true_flops = 2.0 * b * g**4 * k**4 * cin * cout
+    print(
+        f"dx [{b},{g}^4] {cin}->{cout} k={k}^4 {dtype.name}: "
+        f"{true_flops / 1e12:.3f} TFLOP true"
+    )
+
+    for form in args.forms:
+        kind, impl = form.split(":", 1)
+        if kind == "transpose":
+
+            def dx_fn(gg, w, impl=impl):
+                tx = jax.linear_transpose(
+                    lambda xx: conv4d(xx, w, impl=impl), x0
+                )
+                (dx,) = tx(gg)
+                return dx
+
+        else:
+            assert kind == "explicit", form
+
+            def dx_fn(gg, w, impl=impl):
+                return conv4d(
+                    gg, _flip_transpose(w).astype(gg.dtype), impl=impl
+                )
+
+        def make_chain(n, dx_fn=dx_fn):
+            @jax.jit
+            def f(gg, w):
+                acc = gg
+                for _ in range(n):
+                    dx = dx_fn(acc, w)
+                    # chain through a cheap reduction back to g's shape
+                    acc = acc + jnp.mean(dx, axis=-1, keepdims=True).astype(
+                        gg.dtype
+                    ) * jnp.ones((cout,), dtype)
+                return acc
+
+            return f, (gr, w0)
+
+        try:
+            dt = time_chain(make_chain)
+        except Exception as e:
+            print(f"  {form:16s}: FAILED {type(e).__name__}: {str(e)[:110]}")
+            continue
+        print(
+            f"  {form:16s}: {dt * 1e3:8.2f} ms  "
+            f"{true_flops / dt / 1e12:7.2f} TFLOP/s true-rate"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -8,7 +8,7 @@ intentionally not reproduced.
 
 import jax.numpy as jnp
 
-from ncnet_tpu.models import densenet, resnet, vgg
+from ncnet_tpu.models import densenet, patch, resnet, vgg
 from ncnet_tpu.ops.norm import feature_l2norm
 
 BACKBONES = {
@@ -20,6 +20,11 @@ BACKBONES = {
         16,
         256,
     ),
+    # framework extension (models/patch.py): pretrained-free DISCRIMINATIVE
+    # trunk for the zero-egress synthetic proofs — a random-orthogonal
+    # patch embed preserves patch inner products, which no randomly-
+    # initialized deep trunk does
+    "patch16": (patch.init_patch_trunk, patch.patch_trunk_apply, 16, 256),
 }
 
 

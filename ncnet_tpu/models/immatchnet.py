@@ -60,6 +60,10 @@ class ImMatchNetConfig:
     # Subtract the per-image spatial feature mean before L2-norm (framework
     # extension, off = reference semantics; see feature_extraction_apply).
     center_features: bool = False
+    # NC weight init: 'reference' (torch _ConvNd uniform) or 'identity'
+    # (center-tap pass-through + small noise — the basin from which weak
+    # training demonstrably improves matching; see init_neigh_consensus).
+    nc_init: str = "reference"
 
     def replace(self, **kw):
         return dataclasses.replace(self, **kw)
@@ -88,7 +92,10 @@ def init_immatchnet(rng, config: ImMatchNetConfig):
             k_fe, config.feature_extraction_cnn
         ),
         "neigh_consensus": init_neigh_consensus(
-            k_nc, config.ncons_kernel_sizes, config.ncons_channels
+            k_nc,
+            config.ncons_kernel_sizes,
+            config.ncons_channels,
+            scheme=config.nc_init,
         ),
     }
 

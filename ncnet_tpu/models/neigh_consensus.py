@@ -14,28 +14,48 @@ from jax.ad_checkpoint import checkpoint_name
 from ncnet_tpu.ops.conv4d import conv4d_packed, resolve_layer_impls
 
 
-def init_neigh_consensus(rng, kernel_sizes=(3, 3, 3), channels=(10, 10, 1)):
+def init_neigh_consensus(rng, kernel_sizes=(3, 3, 3), channels=(10, 10, 1),
+                         scheme="reference", identity_noise=0.02):
     """Per-layer ``{'kernel': [k,k,k,k,cin,cout], 'bias': [cout]}``.
 
-    Init matches the reference Conv4d's inherited torch ``_ConvNd`` default
-    (uniform in ±1/sqrt(fan_in)).
+    ``scheme='reference'`` matches the reference Conv4d's inherited torch
+    ``_ConvNd`` default (uniform in ±1/sqrt(fan_in)).
+
+    ``scheme='identity'`` (framework extension): a center-tap channel-0
+    pass-through plus ``identity_noise``-scaled Gaussian perturbation —
+    the stack starts as (approximately) the identity on the correlation.
+    Measured round 4 (v5e, patch16 trunk, synthetic rolled pairs): weak-
+    loss training from the REFERENCE init lands in a degenerate basin
+    (the loss falls while transfer PCK drops below even the zero-shift
+    diagonal baseline), while from this init the same loss takes PCK
+    0.73 -> 0.98 in 400 steps. Used by the synthetic proofs
+    (scripts/synthetic_convergence.py, scripts/synthetic_inloc_e2e.py).
     """
     assert len(kernel_sizes) == len(channels)
     params = []
     cin = 1
     keys = jax.random.split(rng, len(channels))
     for key, k, cout in zip(keys, kernel_sizes, channels):
-        fan_in = cin * k**4
-        bound = (1.0 / fan_in) ** 0.5
         k1, k2 = jax.random.split(key)
-        params.append(
-            {
-                "kernel": jax.random.uniform(
-                    k1, (k, k, k, k, cin, cout), minval=-bound, maxval=bound
-                ),
-                "bias": jax.random.uniform(k2, (cout,), minval=-bound, maxval=bound),
-            }
-        )
+        if scheme == "identity":
+            kern = identity_noise * jax.random.normal(
+                k1, (k, k, k, k, cin, cout)
+            )
+            c = k // 2
+            kern = kern.at[c, c, c, c, 0, 0].add(1.0)
+            bias = jnp.zeros((cout,))
+        elif scheme == "reference":
+            fan_in = cin * k**4
+            bound = (1.0 / fan_in) ** 0.5
+            kern = jax.random.uniform(
+                k1, (k, k, k, k, cin, cout), minval=-bound, maxval=bound
+            )
+            bias = jax.random.uniform(
+                k2, (cout,), minval=-bound, maxval=bound
+            )
+        else:
+            raise ValueError(f"unknown NC init scheme {scheme!r}")
+        params.append({"kernel": kern, "bias": bias})
         cin = cout
     return params
 

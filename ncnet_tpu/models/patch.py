@@ -1,0 +1,57 @@
+"""Patch-embedding trunk: one 16x16/stride-16 random-orthogonal projection.
+
+Framework extension with no reference counterpart (the reference's trunks
+are pretrained torchvision CNNs, lib/model.py:19-87). Purpose: a
+DISCRIMINATIVE feature extractor that needs no pretrained weights — a
+random orthonormal-column projection Q of each 16x16 patch computes
+<Q^T p1, Q^T p2> = p1^T QQ^T p2, the inner product of the patches'
+rank-256 projections: for natural/noise patches (energy spread over the
+768 dims) feature correlation tracks raw patch correlation closely, and
+identical patches map to identical features exactly.
+Randomly-initialized deep trunks measurably do NOT
+have this property (their ReLU stacks contract inputs toward a shared
+direction: pairwise feature cosines ~0.96 regardless of content — see
+`feature_extraction_apply(center=...)` notes), which makes them useless
+as matching front ends without pretrained weights; this trunk is what
+makes the zero-egress synthetic end-to-end proofs
+(scripts/synthetic_convergence.py, scripts/synthetic_inloc_e2e.py)
+genuinely exercise correspondence learning instead of a degenerate
+diagonal prior.
+
+TPU-native: the patch embed is ONE stride-16 conv (a single MXU GEMM per
+location) — the ViT patch-embedding idiom.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PATCH = 16
+CHANNELS = 256
+
+
+def init_patch_trunk(rng):
+    """[16, 16, 3, 256] kernel with orthonormal COLUMNS (QR of a
+    Gaussian): patch -> feature is inner-product-preserving on the
+    256-dim subspace the columns span."""
+    flat = jax.random.normal(rng, (PATCH * PATCH * 3, CHANNELS))
+    q, _ = jnp.linalg.qr(flat)  # [768, 256], orthonormal columns
+    return {"kernel": q.reshape(PATCH, PATCH, 3, CHANNELS)}
+
+
+def patch_trunk_apply(params, image):
+    """``[b, h, w, 3]`` -> ``[b, h/16, w/16, 256]`` non-overlapping
+    patch projections. Mean-subtraction per patch is implicit in the
+    downstream `feature_l2norm` path when enabled via
+    ``center_features``; here the raw projection is returned."""
+    dn = lax.conv_dimension_numbers(
+        image.shape, params["kernel"].shape, ("NHWC", "HWIO", "NHWC")
+    )
+    return lax.conv_general_dilated(
+        image,
+        params["kernel"].astype(image.dtype),
+        window_strides=(PATCH, PATCH),
+        padding="VALID",
+        dimension_numbers=dn,
+        preferred_element_type=image.dtype,
+    )

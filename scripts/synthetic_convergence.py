@@ -1,11 +1,17 @@
 """End-to-end learning proof on synthetic data: train the NC head with the
 weak loss on `SyntheticPairDataset` (known cyclic-shift ground truth) and
-report (a) the training-loss curve and (b) a PCK-style keypoint-transfer
-metric before vs after — demonstrating convergence with no dataset on disk.
+report (a) the training-loss curve, (b) a PCK-style keypoint-transfer
+metric before vs after, and (c) the DEGENERATE zero-shift baseline the
+metric must beat — demonstrating convergence with no dataset on disk.
 
-Measured on a v5e (defaults: 400 steps, lr 5e-3, 128px): loss
--0.0011 -> -0.0058 (decile means) and transfer PCK@0.15
-0.055 -> 0.375 (~7x above chance). Runs anywhere (TPU or CPU):
+Measured on a v5e (round 4; defaults: patch16 trunk, identity NC init,
+lr 5e-4, 128px): loss -0.13 -> -0.76 (decile means) and transfer
+PCK@0.15 0.73 -> 0.98 against a 0.31 degenerate-diagonal baseline.
+Negative results kept honest in-code: with a randomly-initialized DEEP
+trunk, or from the reference's uniform NC init, the same weak loss falls
+just as happily while PCK lands AT or BELOW that degenerate baseline —
+the pre-round-4 version of this script was certifying exactly that.
+Runs anywhere (TPU or CPU):
   python scripts/synthetic_convergence.py [--image_size 128 --steps 200]
 """
 
@@ -18,9 +24,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def run(image_size=128, steps=400, batch=8, n_pairs=32, lr=5e-3, seed=0,
+def run(image_size=128, steps=400, batch=8, n_pairs=32, lr=5e-4, seed=0,
         ncons_kernel_sizes=(3, 3), ncons_channels=(16, 1), alpha=0.15,
-        conv4d_impl="cfs", log_every=20, verbose=True):
+        conv4d_impl="cfs", fe_arch="patch16", nc_init="identity",
+        log_every=20, verbose=True):
     import jax
 
     from ncnet_tpu.data.loader import DataLoader
@@ -34,6 +41,7 @@ def run(image_size=128, steps=400, batch=8, n_pairs=32, lr=5e-3, seed=0,
     )
 
     config = ImMatchNetConfig(
+        feature_extraction_cnn=fe_arch,
         ncons_kernel_sizes=tuple(ncons_kernel_sizes),
         ncons_channels=tuple(ncons_channels),
         conv4d_impl=conv4d_impl,
@@ -41,7 +49,16 @@ def run(image_size=128, steps=400, batch=8, n_pairs=32, lr=5e-3, seed=0,
         # the random trunk's correlations real contrast (see
         # feature_extraction_apply docstring)
         center_features=True,
+        nc_init=nc_init,
     )
+    # Round-4 measured defaults that make this a REAL proof:
+    # - trunk 'patch16' (models/patch.py): a randomly-initialized DEEP
+    #   trunk has near-constant pairwise feature cosines (~0.96 on
+    #   textured pairs), so its correlations carry almost no signal;
+    # - nc_init 'identity': from the reference's uniform init the weak
+    #   loss falls while transfer PCK drops BELOW the degenerate
+    #   zero-shift baseline (a non-matching optimum); from the near-
+    #   identity basin the same loss drives PCK 0.73 -> 0.98.
     params = init_immatchnet(jax.random.PRNGKey(seed), config)
 
     size = (image_size, image_size)
@@ -80,15 +97,24 @@ def run(image_size=128, steps=400, batch=8, n_pairs=32, lr=5e-3, seed=0,
     pck_after = evaluate_synthetic(state.params, config, eval_loader, alpha=alpha)
     first = float(np.mean(losses[: max(len(losses) // 10, 1)]))
     last = float(np.mean(losses[-max(len(losses) // 10, 1):]))
+    # Honesty gauge (round 4): the PCK a DEGENERATE zero-shift (diagonal)
+    # predictor would score on this eval set — a point is "correct" for it
+    # whenever the pair's shift is under the PCK radius. A trained model
+    # must clear this, not just chance; deep random trunks do not.
+    pck_diagonal = float(np.mean([
+        eval_ds[i]["shift"] <= alpha * image_size for i in range(len(eval_ds))
+    ]))
     if verbose:
         print(f"loss: first-decile mean {first:+.6f} -> last-decile mean {last:+.6f}")
-        print(f"synthetic transfer PCK@{alpha}: {pck_before:.3f} -> {pck_after:.3f}")
+        print(f"synthetic transfer PCK@{alpha}: {pck_before:.3f} -> {pck_after:.3f} "
+              f"(degenerate-diagonal baseline {pck_diagonal:.3f})")
     return {
         "loss_first": first,
         "loss_last": last,
         "losses": losses,
         "pck_before": pck_before,
         "pck_after": pck_after,
+        "pck_diagonal_baseline": pck_diagonal,
         # trained params + config so downstream synthetic end-to-end
         # proofs (scripts/synthetic_inloc_e2e.py) can reuse the model
         "params": state.params,
@@ -101,7 +127,7 @@ def main():
     p.add_argument("--image_size", type=int, default=128)
     p.add_argument("--steps", type=int, default=400)
     p.add_argument("--batch", type=int, default=8)
-    p.add_argument("--lr", type=float, default=5e-3)
+    p.add_argument("--lr", type=float, default=5e-4)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--alpha", type=float, default=0.15)
     # same surface as scripts/train.py: no 'pallas' (interpret-mode only);
@@ -118,11 +144,22 @@ def main():
         return value
 
     p.add_argument("--conv4d_impl", type=impl_arg, default="cfs")
+    p.add_argument("--fe_arch", default="patch16",
+                   help="trunk; 'patch16' (default) is the random-"
+                        "orthogonal patch embed — deep random trunks "
+                        "train to the degenerate diagonal (see run())")
+    p.add_argument("--nc_init", default="identity",
+                   choices=["identity", "reference"],
+                   help="NC weight init; 'reference' demonstrably lands "
+                        "the weak loss in a non-matching optimum on this "
+                        "synthetic task (kept for the record)")
     p.add_argument("--ncons_kernel_sizes", nargs="+", type=int, default=[3, 3])
     p.add_argument("--ncons_channels", nargs="+", type=int, default=[16, 1])
     args = p.parse_args()
     out = run(
         image_size=args.image_size,
+        fe_arch=args.fe_arch,
+        nc_init=args.nc_init,
         steps=args.steps,
         batch=args.batch,
         lr=args.lr,
@@ -132,7 +169,14 @@ def main():
         ncons_kernel_sizes=tuple(args.ncons_kernel_sizes),
         ncons_channels=tuple(args.ncons_channels),
     )
-    ok = out["loss_last"] < out["loss_first"] and out["pck_after"] > out["pck_before"]
+    # the gate must beat the DEGENERATE predictor, not just the random
+    # init: a model that collapsed to the diagonal scores exactly the
+    # baseline (the round-4 finding for deep random trunks)
+    ok = (
+        out["loss_last"] < out["loss_first"]
+        and out["pck_after"] > out["pck_before"]
+        and out["pck_after"] > out["pck_diagonal_baseline"]
+    )
     print(f"convergence {'OK' if ok else 'NOT DEMONSTRATED'}")
     sys.exit(0 if ok else 1)
 

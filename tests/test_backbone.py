@@ -85,3 +85,47 @@ def test_vgg16_trunk_shape():
     params = vgg.init_vgg16_trunk(jax.random.PRNGKey(0))
     feats = vgg.vgg16_trunk_apply(params, jnp.zeros((1, 64, 64, 3)))
     assert feats.shape == (1, 4, 4, 512)
+
+
+def test_patch16_trunk_orthogonal_and_discriminative():
+    """The patch16 trunk (models/patch.py) must (a) produce stride-16
+    features, (b) preserve patch inner products (orthonormal projection),
+    and (c) make exact patch matches the correlation argmax — the
+    property that justifies its existence for the synthetic proofs."""
+    from ncnet_tpu.models import patch
+    from ncnet_tpu.models.feature_extraction import (
+        backbone_channels,
+        backbone_stride,
+        feature_extraction_apply,
+        init_feature_extraction,
+    )
+
+    assert backbone_stride("patch16") == 16
+    params = init_feature_extraction(jax.random.PRNGKey(0), "patch16")
+    k = np.asarray(params["kernel"]).reshape(-1, patch.CHANNELS)
+    np.testing.assert_allclose(
+        k.T @ k, np.eye(patch.CHANNELS), atol=1e-4
+    )  # orthonormal columns
+
+    rng = np.random.RandomState(0)
+    img = rng.rand(1, 64, 64, 3).astype(np.float32)
+    feats = patch.patch_trunk_apply(params, jnp.asarray(img))
+    assert feats.shape == (1, 4, 4, backbone_channels("patch16"))
+    # inner products preserved: <Q p1, Q p2> == <p1 - ?, ...> up to the
+    # rank-256 projection; identical patches must map to identical feats
+    img2 = np.roll(img, 16, axis=2)  # shift by exactly one patch
+    feats2 = patch.patch_trunk_apply(params, jnp.asarray(img2))
+    np.testing.assert_allclose(
+        np.asarray(feats)[0, :, :3], np.asarray(feats2)[0, :, 1:4], atol=1e-5
+    )
+
+    # correlation argmax picks the true (shifted) patch, full trunk path
+    fa = feature_extraction_apply({"kernel": params["kernel"]}, jnp.asarray(img), cnn="patch16", center=True)
+    fb = feature_extraction_apply({"kernel": params["kernel"]}, jnp.asarray(img2), cnn="patch16", center=True)
+    from ncnet_tpu.ops.correlation import correlation_4d
+
+    corr = np.asarray(correlation_4d(fa, fb))[0]
+    for i in range(4):
+        for j in range(3):
+            ia, ja = divmod(corr[:, :, i, j + 1].reshape(-1).argmax(), 4)
+            assert (ia, ja) == (i, j), (i, j, ia, ja)
