@@ -31,15 +31,19 @@ A Pallas kernel cannot beat this either: Mosaic requires 8-aligned
 sublane offsets, but conv4d row shifts have granularity 1 in the fused
 (j,k) dims, forcing the same banded/inflated formulations (>=3.2x
 effective with K/N pads) that XLA already runs at 70% peak.
-Best known config (16.17 pairs/s, 14.2% MFU, vs_baseline 4.04): PER-LAYER
-impl mixing 'tlc//btl,btl4,tlc/tlc/tf3' + loss_chunk 8 + 'nc_conv'
-save-policy remat — round 4 adds the dw (kernel-gradient) slot: the edge
-layers' dw transposes a DIFFERENT formulation than their forward ('btl'
-for 1->16: 22.4 ms vs tlc's 24.8; 'tf3' for 16->1: 13.2 ms vs 18.3),
-while the middle layer keeps btl4's own transpose (39.7 ms — every
-measured alternative loses: tlc 83.7, cf 113.7, btl5 42.9, rank-4 'xla'
-174.2, and the direct tap-folded GEMM 'dwe*' forms are gather-bound at
-450-1150 ms). Block re-sweep under this regime: btl3 15.3, btl4 16.17,
+Best known config (17.43 pairs/s, 15.3% MFU, vs_baseline 4.36): PER-LAYER
+impl mixing 'tlc//btl,btl4,tlc/tlc/tf3' + loss_chunk 8 WITHOUT the chunk
+remat. Round 4 added (a) the dw (kernel-gradient) slot: the edge layers'
+dw transposes a DIFFERENT formulation than their forward ('btl' for
+1->16: 22.4 ms vs tlc's 24.8; 'tf3' for 16->1: 13.2 ms vs 18.3), while
+the middle layer keeps btl4's own transpose (39.7 ms — every measured
+alternative loses: tlc 83.7, cf 113.7, btl5 42.9, rank-4 'xla' 174.2,
+and the direct tap-folded GEMM 'dwe*' forms are gather-bound at 450-1150
+ms); and (b) dropping the per-chunk remat (16.17 -> 17.43): the
+composite custom-VJPs save only (x, w) per conv, so the un-remat'd
+residuals now fit where they OOM'd in r2 — while the gather-heavy impls
+(cf1/cf/tf2 forwards, btl4/cf dx) still OOM without remat, closing that
+design space from both sides. Block re-sweep: btl3 15.3, btl4 16.17,
 btl5 14.3, btl6 13.1 pairs/s — block 4 stays the sweet spot. The middle 16->16 layer (89% of stack FLOPs) uses the 5D-safe
 blocked Toeplitz at block 4 (1.79x inflation, the measured sweet spot:
 block 2 = 14.0 pairs/s end-to-end, block 5 = 14.0, block 8 = 14.6, dense
@@ -106,9 +110,10 @@ def main():
                         "'<fwd>/<dx>' composes forward and input-grad "
                         "lowerings (measured-best default)")
     p.add_argument("--nc_remat", action="store_true")
-    p.add_argument("--no_chunk_remat", action="store_true",
-                   help="disable per-chunk rematerialization (needs the "
-                        "packed-layout residuals to fit in HBM)")
+    p.add_argument("--chunk_remat", action="store_true",
+                   help="re-enable per-chunk rematerialization (the r2-r3 "
+                        "regime; a net loss since the composite VJPs "
+                        "shrank the un-remat'd residuals — see PERF.md)")
     p.add_argument("--loss_chunk", type=int, default=8)
     p.add_argument("--sym_seq", action="store_true",
                    help="run the symmetric NC passes sequentially instead "
@@ -138,7 +143,7 @@ def main():
         conv4d_impl=args.conv4d_impl,
         nc_remat=args.nc_remat,
         loss_chunk=args.loss_chunk,
-        loss_chunk_remat=not args.no_chunk_remat,
+        loss_chunk_remat=args.chunk_remat,
         symmetric_batch=not args.sym_seq,
     )
     params = init_immatchnet(jax.random.PRNGKey(0), config)

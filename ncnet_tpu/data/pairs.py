@@ -179,18 +179,27 @@ class SyntheticPairDataset:
     y), which `eval.synthetic` uses for a PCK-style transfer metric.
     """
 
-    def __init__(self, n=256, output_size=(400, 400), seed=0, return_shift=False):
+    def __init__(self, n=256, output_size=(400, 400), seed=0,
+                 return_shift=False, granularity=8):
+        """``granularity``: pixel scale of the noise texture (base noise is
+        upsampled by this factor). 8 is the training default; coarser
+        textures (e.g. 32) keep patch correlation high under sub-cell
+        (non-stride-aligned) shifts — used by the demo figure where a
+        CONSTRUCTED (untrained) model must resolve arbitrary shifts."""
         self.n = n
         self.out_h, self.out_w = output_size
         self.seed = seed
         self.return_shift = return_shift
+        self.granularity = granularity
 
     def __len__(self):
         return self.n
 
     def __getitem__(self, idx):
         rng = np.random.RandomState(self.seed * 100003 + idx)
-        base = rng.rand(self.out_h // 8, self.out_w // 8, 3).astype(np.float32)
+        # clamp so tiny output sizes still get a >=1-cell base texture
+        g = min(self.granularity, self.out_h, self.out_w)
+        base = rng.rand(self.out_h // g, self.out_w // g, 3).astype(np.float32)
         img = resize_bilinear_np(base * 255.0, self.out_h, self.out_w)
         shift = rng.randint(0, self.out_w // 2)
         tgt = np.roll(img, shift, axis=1)

@@ -99,6 +99,14 @@ def main():
                         "The measured-best single-chip config is 8 (see "
                         "bench.py); leave unset for multi-device data "
                         "parallelism")
+    p.add_argument("--chunk_remat", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="rematerialize each loss chunk (the r2-r3 regime; "
+                        "measured a net LOSS since the composite conv4d "
+                        "VJPs shrank the un-remat'd residuals — "
+                        "benchmarks/PERF.md). Fresh configs default off; "
+                        "checkpoint resumes keep their recorded value "
+                        "unless --chunk_remat/--no-chunk_remat is given")
     args = p.parse_args()
 
     def default_impl(n_layers):
@@ -164,6 +172,7 @@ def main():
             conv4d_impl=args.conv4d_impl
             or default_impl(len(config.ncons_channels)),
             loss_chunk=chunk, nc_remat=chunk == 0,
+            loss_chunk_remat=bool(args.chunk_remat),
         )
         print(f"initialized from reference checkpoint {args.checkpoint} "
               "(weights-only: torch optimizer state is not portable)")
@@ -177,6 +186,9 @@ def main():
                 loss_chunk=args.loss_chunk,
                 nc_remat=args.loss_chunk == 0,
             )
+        if args.chunk_remat is not None:  # override in EITHER direction;
+            # unset keeps the checkpoint's recorded value
+            config = config.replace(loss_chunk_remat=args.chunk_remat)
         # the checkpoint records WHICH params were training (the opt-state
         # pytree shape depends on it); default flags adopt its mode, an
         # explicit different mode restarts the optimizer
@@ -213,9 +225,10 @@ def main():
             conv4d_impl=args.conv4d_impl
             or default_impl(len(args.ncons_channels)),
             loss_chunk=args.loss_chunk or 0,
-            # chunking brings its own conv-saving remat policy; per-layer
-            # remat is the memory bound for the unchunked path
+            # per-layer remat is the memory bound for the unchunked path;
+            # chunk remat is off by default since round 4 (PERF.md)
             nc_remat=not args.loss_chunk,
+            loss_chunk_remat=bool(args.chunk_remat),
         )
         params = init_immatchnet(jax.random.PRNGKey(args.seed), config)
 
