@@ -38,6 +38,12 @@ def main():
                         "measured-best at InLoc grids: 0.92 s/pair vs "
                         "btl4 2.55, scan 14.6 — see "
                         "benchmarks/micro_inloc.py)")
+    p.add_argument("--device_preprocess", type=str2bool, default=True,
+                   help="ship images to the device as uint8 and ImageNet-"
+                        "normalize there (4x less transfer; differs from "
+                        "the host-fp32 path only by uint8 rounding of the "
+                        "resized pixels). false = exact host-fp32 "
+                        "preprocessing")
     p.add_argument("--spatial_shards", type=int, default=0,
                    help="shard the correlation pipeline over this many "
                         "devices ('spatial' mesh axis) for grids beyond "
@@ -116,6 +122,7 @@ def main():
         and not args.matching_both_directions,
         mesh=mesh,
         softmax=args.softmax,
+        device_preprocess=args.device_preprocess,
     )
 
 
