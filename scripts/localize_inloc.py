@@ -48,6 +48,49 @@ def parse_cutout_name(pano_fn):
     return floor, parts[0], parts[2]
 
 
+def _localize_query(task):
+    """One query's PnP stage (worker-safe: module-level + picklable args;
+    the reference runs exactly this loop under MATLAB parfor,
+    parfor_NC4D_PE_pnponly.m). Returns the result entry dict."""
+    from scipy.io import loadmat
+
+    from ncnet_tpu.eval.localize import pnp_localize_pair
+
+    q = task["q"]
+    matches = loadmat(task["match_path"])["matches"]  # [1, Npanos, N, 5]
+    from PIL import Image
+
+    with Image.open(task["query_img"]) as im:
+        qw, qh = im.size
+    entry = {"queryname": task["query_fn"], "topNname": [], "P": []}
+    for idx, pano_fn in enumerate(task["pano_fns"][: matches.shape[1]]):
+        cutout = load_cutout(
+            os.path.join(task["cutout_dir"], pano_fn + ".mat")
+        )
+        align = None
+        if task["transform_dir"]:
+            floor, scene_id, scan_id = parse_cutout_name(pano_fn)
+            align = load_alignment(
+                os.path.join(
+                    task["transform_dir"], floor, "transformations",
+                    f"{scene_id}_trans_{scan_id}.txt",
+                )
+            )
+        out = pnp_localize_pair(
+            matches[0, idx],
+            (qh, qw),
+            cutout.shape[:2],
+            cutout,
+            task["focal"],
+            alignment=align,
+            score_thr=task["score_thr"],
+            pnp_thr_deg=task["pnp_thr_deg"],
+        )
+        entry["topNname"].append(pano_fn)
+        entry["P"].append(None if out["P"] is None else out["P"].tolist())
+    return q, entry
+
+
 @functools.lru_cache(maxsize=256)
 def load_alignment(path):
     """Last 4 numeric rows of the transformation txt -> [4, 4] P_after."""
@@ -71,7 +114,6 @@ def main():
     from ncnet_tpu.eval.inloc import _to_str
     from ncnet_tpu.eval.localize import (
         localization_rate_curve,
-        pnp_localize_pair,
         pose_distance,
     )
 
@@ -107,6 +149,10 @@ def main():
     p.add_argument("--method", default="ncnet_tpu",
                    help="method label used in the persisted artifact names "
                         "(error_<method>.txt, curve_<method>.png)")
+    p.add_argument("--workers", type=int, default=1,
+                   help="parallelize the per-query PnP stage over this "
+                        "many processes (the reference runs it under "
+                        "MATLAB parfor); cutout caches are per worker")
     args = p.parse_args()
     if args.densePV and not args.scan_dir:
         p.error("--densePV requires --scan_dir")
@@ -114,48 +160,51 @@ def main():
     from PIL import Image
 
     db = loadmat(args.shortlist)["ImgList"][0, :]
-    results = []
+    tasks = []
     for q in range(min(args.n_queries, len(db))):
         match_path = os.path.join(args.matches_dir, f"{q + 1}.mat")
         if not os.path.exists(match_path):
             print(f"skip query {q + 1}: {match_path} missing", flush=True)
             continue
-        matches = loadmat(match_path)["matches"]  # [1, Npanos, N, 5]
         query_fn = _to_str(db[q][0])
-        with Image.open(os.path.join(args.query_dir, query_fn)) as im:
-            qw, qh = im.size
-        entry = {"queryname": query_fn, "topNname": [], "P": []}
-        for idx in range(min(args.n_panos, matches.shape[1])):
-            pano_fn = _to_str(db[q][1].ravel()[idx])
-            cutout = load_cutout(
-                os.path.join(args.cutout_dir, pano_fn + ".mat")
-            )
-            align = None
-            if args.transform_dir:
-                floor, scene_id, scan_id = parse_cutout_name(pano_fn)
-                align = load_alignment(
-                    os.path.join(
-                        args.transform_dir, floor, "transformations",
-                        f"{scene_id}_trans_{scan_id}.txt",
-                    )
+        tasks.append({
+            "q": q,
+            "match_path": match_path,
+            "query_fn": query_fn,
+            "query_img": os.path.join(args.query_dir, query_fn),
+            "pano_fns": [
+                _to_str(v) for v in db[q][1].ravel()[: args.n_panos]
+            ],
+            "cutout_dir": args.cutout_dir,
+            "transform_dir": args.transform_dir,
+            "focal": args.focal,
+            "score_thr": args.score_thr,
+            "pnp_thr_deg": args.pnp_thr_deg,
+        })
+
+    results = []
+    if args.workers > 1:
+        import multiprocessing
+
+        with multiprocessing.Pool(args.workers) as pool:
+            # contiguous chunks keep each worker on NEIGHBORING queries,
+            # whose top-10 shortlists overlap heavily — that locality is
+            # what the per-worker load_cutout/load_alignment caches need
+            chunk = max(1, len(tasks) // (4 * args.workers))
+            for q, entry in pool.imap(_localize_query, tasks, chunk):
+                results.append(entry)
+                print(
+                    f"query {q + 1}: "
+                    f"{sum(p_ is not None for p_ in entry['P'])} poses",
+                    flush=True,
                 )
-            out = pnp_localize_pair(
-                matches[0, idx],
-                (qh, qw),
-                cutout.shape[:2],
-                cutout,
-                args.focal,
-                alignment=align,
-                score_thr=args.score_thr,
-                pnp_thr_deg=args.pnp_thr_deg,
-            )
-            entry["topNname"].append(pano_fn)
-            entry["P"].append(
-                None if out["P"] is None else out["P"].tolist()
-            )
-        results.append(entry)
-        print(f"query {q + 1}: {sum(p_ is not None for p_ in entry['P'])} "
-              f"poses", flush=True)
+    else:
+        for task in tasks:
+            q, entry = _localize_query(task)
+            results.append(entry)
+            print(f"query {q + 1}: "
+                  f"{sum(p_ is not None for p_ in entry['P'])} poses",
+                  flush=True)
 
     if args.densePV:
         from ncnet_tpu.eval.pose_verify import (
