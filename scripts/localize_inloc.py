@@ -183,28 +183,28 @@ def main():
         })
 
     results = []
+    pool = None
     if args.workers > 1:
         import multiprocessing
 
-        with multiprocessing.Pool(args.workers) as pool:
-            # contiguous chunks keep each worker on NEIGHBORING queries,
-            # whose top-10 shortlists overlap heavily — that locality is
-            # what the per-worker load_cutout/load_alignment caches need
-            chunk = max(1, len(tasks) // (4 * args.workers))
-            for q, entry in pool.imap(_localize_query, tasks, chunk):
-                results.append(entry)
-                print(
-                    f"query {q + 1}: "
-                    f"{sum(p_ is not None for p_ in entry['P'])} poses",
-                    flush=True,
-                )
+        pool = multiprocessing.Pool(args.workers)
+        # contiguous chunks keep each worker on NEIGHBORING queries,
+        # whose top-10 shortlists overlap heavily — that locality is
+        # what the per-worker load_cutout/load_alignment caches need
+        chunk = max(1, len(tasks) // (4 * args.workers))
+        outputs = pool.imap(_localize_query, tasks, chunk)
     else:
-        for task in tasks:
-            q, entry = _localize_query(task)
+        outputs = map(_localize_query, tasks)
+    try:
+        for q, entry in outputs:
             results.append(entry)
             print(f"query {q + 1}: "
                   f"{sum(p_ is not None for p_ in entry['P'])} poses",
                   flush=True)
+    finally:
+        if pool is not None:
+            pool.close()
+            pool.join()
 
     if args.densePV:
         from ncnet_tpu.eval.pose_verify import (

@@ -161,3 +161,45 @@ def test_dump_matches_contract(tiny, tmp_path):
     # at least some slots filled for both panos
     assert (np.abs(out["matches"][0, 0]).sum() > 0)
     assert (np.abs(out["matches"][0, 1]).sum() > 0)
+
+
+def test_device_preprocess_matches_host_path(tiny, tmp_path):
+    """The uint8 + on-device-normalize dump path (round 4, a 4x H2D
+    saving on tunneled hosts) must agree with the host-fp32 path to
+    within the uint8 rounding of resized pixels: same match INDICES,
+    scores within a loose tolerance."""
+    from PIL import Image
+
+    from ncnet_tpu.eval.inloc import load_and_preprocess
+
+    rng = np.random.RandomState(3)
+    p = tmp_path / "img.png"
+    Image.fromarray(rng.randint(0, 255, (70, 90, 3), np.uint8)).save(p)
+    p2 = tmp_path / "img2.png"
+    Image.fromarray(rng.randint(0, 255, (80, 64, 3), np.uint8)).save(p2)
+
+    host = [load_and_preprocess(str(q), 64, 1) for q in (p, p2)]
+    dev = [
+        load_and_preprocess(str(q), 64, 1, device_normalize=True)
+        for q in (p, p2)
+    ]
+    assert dev[0].dtype == np.uint8
+    assert dev[0].shape == host[0].shape
+
+    fn_host = make_match_fn(TINY)
+    fn_dev = make_match_fn(TINY, device_preprocess=True)
+    out_h = match_pair(
+        fn_host, tiny, jnp.asarray(host[0]), jnp.asarray(host[1]), 0
+    )
+    out_d = match_pair(
+        fn_dev, tiny, jnp.asarray(dev[0]), jnp.asarray(dev[1]), 0
+    )
+    # match_pair's sort+dedup makes element ORDER (and possibly length)
+    # depend on tiny score perturbations, so compare the match SETS:
+    # nearly all (xa, ya, xb, yb) rows must coincide
+    rows_h = {tuple(np.round(r, 6)) for r in np.stack(out_h[:4], axis=1)}
+    rows_d = {tuple(np.round(r, 6)) for r in np.stack(out_d[:4], axis=1)}
+    overlap = len(rows_h & rows_d) / max(len(rows_h), 1)
+    assert overlap > 0.9, (overlap, len(rows_h), len(rows_d))
+    # score distributions agree in scale
+    assert abs(float(np.mean(out_h[4])) - float(np.mean(out_d[4]))) < 0.05
