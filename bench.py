@@ -78,25 +78,68 @@ import time
 
 import numpy as np
 
+# Kept for external readers (BENCH_r*.json history); == pfpascal anchor.
 V100_EST_PAIRS_PER_SEC = 4.0
 V5E_BF16_PEAK_FLOPS = 197e12
 
+# Named flagship configs (reference README.md:42,48 — PF-Pascal trains
+# 5-5-5/16-16-1, IVD/InLoc trains 3-3/16-1; both at 400x400 / batch 16).
+#
+# Each carries its own analytic V100 anchor with error bounds (derivation
+# in BASELINE.md "Anchor bounds"); the reference publishes no throughput,
+# so vs_baseline reads "x an estimate bounded in [lo, hi]":
+#   pfpascal — ~2 TFLOP/pair, dominated by the 5^4 NC stack run through
+#     the Python-loop conv4d (25 slices x 11 cuDNN conv3d calls/layer,
+#     reference lib/conv4d.py:39-48). Upper bound 6.5 pairs/s = conv3d
+#     shapes at ~80% of the 15.7 TFLOPs fp32 peak with free launches;
+#     lower bound 2.4 = ~35% efficiency + ~10 us x ~3.3k launches/step.
+#   ivd — NC shrinks 70x (3^4 kernels, 2 layers: ~24 GFLOP/pair) and the
+#     4 unshared trunk passes/pair (the reference re-extracts features
+#     for the rolled negatives, train.py:138-152) dominate at ~83
+#     GFLOP/pair => ~1.74 TFLOP/step. Upper bound 64 pairs/s = 60%
+#     fp32 efficiency + ~100 ms/step of Python/launch overhead for the
+#     ~1.8k-launch conv4d loop; lower bound 19 = 35% efficiency + ~200 us
+#     per torch-0.3 autograd op. Estimate 35 = midpoint of that range.
+CONFIGS = {
+    "pfpascal": {
+        "kernels": (5, 5, 5),
+        "channels": (16, 16, 1),
+        # measured-best per-layer mix at the 5^4 shapes (PERF.md)
+        "impl": "tlc//btl,btl4,tlc/tlc/tf3",
+        "metric": "train_pairs_per_sec_per_chip_400px_resnet101",
+        "v100_est": 4.0,
+        "v100_bounds": (2.4, 6.5),
+    },
+    "ivd": {
+        "kernels": (3, 3),
+        "channels": (16, 1),
+        # measured-best at the 3^4 shapes (PERF.md "IVD config"): the
+        # composite VJPs that win at 5^4 all LOSE here — plain tlc with
+        # XLA's own transposes is fastest on both layers
+        "impl": "tlc,tlc",
+        "metric": "train_pairs_per_sec_per_chip_400px_resnet101_ivd",
+        "v100_est": 35.0,
+        "v100_bounds": (19.0, 64.0),
+    },
+}
 
-def train_step_flops(batch, grid=25, feat_ch=1024, image=400):
-    """Analytic FLOPs (2*MACs) per training step at the PF-Pascal config.
+
+def train_step_flops(batch, kernels, channels, grid=25, feat_ch=1024,
+                     image=400):
+    """Analytic FLOPs (2*MACs) per training step.
 
     Counted: 2 trunk forwards/sample (features reused for the rolled
     negatives), pos+neg correlation einsums, the symmetric NC stack
-    (5-5-5 / 1-16-16-1 channels) forward for pos+neg, and its backward
-    (~2x forward; the frozen trunk takes no backward).
+    forward for pos+neg, and its backward (~2x forward; the frozen trunk
+    takes no backward).
     """
     resnet101_layer3_224 = 6.5e9  # conv1..layer3 @ 224x224 per image
     trunk = 2 * resnet101_layer3_224 * (image / 224.0) ** 2
     corr = 2 * 2.0 * grid**4 * feat_ch  # pos + neg
-    nc_channels = [1, 16, 16, 1]
+    nc_channels = [1, *channels]
     nc_pass = sum(
-        2.0 * grid**4 * 5**4 * cin * cout
-        for cin, cout in zip(nc_channels[:-1], nc_channels[1:])
+        2.0 * grid**4 * k**4 * cin * cout
+        for k, cin, cout in zip(kernels, nc_channels[:-1], nc_channels[1:])
     )
     nc_fwd = nc_pass * 2 * 2  # symmetric x (pos + neg)
     nc_bwd = 2 * nc_fwd
@@ -105,10 +148,16 @@ def train_step_flops(batch, grid=25, feat_ch=1024, image=400):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--conv4d_impl", default="tlc//btl,btl4,tlc/tlc/tf3",
+    p.add_argument("--config", default="pfpascal", choices=sorted(CONFIGS),
+                   help="flagship training config: 'pfpascal' (NC 5-5-5/"
+                        "16-16-1) or 'ivd' (NC 3-3/16-1, the config that "
+                        "trains the model the InLoc chain consumes — "
+                        "reference README.md:48)")
+    p.add_argument("--conv4d_impl", default=None,
                    help="one impl or a comma-separated per-NC-layer list; "
                         "'<fwd>/<dx>' composes forward and input-grad "
-                        "lowerings (measured-best default)")
+                        "lowerings (default: the measured-best mix for "
+                        "--config)")
     p.add_argument("--nc_remat", action="store_true")
     p.add_argument("--chunk_remat", action="store_true",
                    help="re-enable per-chunk rematerialization (the r2-r3 "
@@ -135,12 +184,14 @@ def main():
         make_train_step,
     )
 
+    preset = CONFIGS[args.config]
+    impl = args.conv4d_impl if args.conv4d_impl is not None else preset["impl"]
     batch_size = args.batch
     config = ImMatchNetConfig(
-        ncons_kernel_sizes=(5, 5, 5),
-        ncons_channels=(16, 16, 1),
+        ncons_kernel_sizes=preset["kernels"],
+        ncons_channels=preset["channels"],
         half_precision=True,  # bf16 correlation/NC path (TPU-native)
-        conv4d_impl=args.conv4d_impl,
+        conv4d_impl=impl,
         nc_remat=args.nc_remat,
         loss_chunk=args.loss_chunk,
         loss_chunk_remat=args.chunk_remat,
@@ -180,15 +231,21 @@ def main():
     assert np.isfinite(loss_host), f"non-finite loss {loss_host}"
 
     pairs_per_sec = batch_size * n_steps / dt
-    step_flops = train_step_flops(batch_size)
+    step_flops = train_step_flops(
+        batch_size, preset["kernels"], preset["channels"]
+    )
     mfu = (step_flops * n_steps / dt) / V5E_BF16_PEAK_FLOPS
     print(
         json.dumps(
             {
-                "metric": "train_pairs_per_sec_per_chip_400px_resnet101",
+                "metric": preset["metric"],
                 "value": round(pairs_per_sec, 3),
                 "unit": "pairs/s",
-                "vs_baseline": round(pairs_per_sec / V100_EST_PAIRS_PER_SEC, 3),
+                "vs_baseline": round(pairs_per_sec / preset["v100_est"], 3),
+                "vs_baseline_bounds": [
+                    round(pairs_per_sec / preset["v100_bounds"][1], 3),
+                    round(pairs_per_sec / preset["v100_bounds"][0], 3),
+                ],
                 "step_ms": round(dt / n_steps * 1e3, 1),
                 "analytic_tflop_per_step": round(step_flops / 1e12, 2),
                 "mfu_vs_v5e_bf16_peak": round(mfu, 4),

@@ -1,0 +1,125 @@
+"""Training input-pipeline throughput vs the device's consumption rate.
+
+Round-4 gap: the threaded loader (data/loader.py, the reference's
+multiprocess-DataLoader role — lib/dataloader.py:154-183) was
+correctness-tested but never measured against the device rate it must
+sustain. The PF-Pascal step at 17.43 pairs/s (BENCH_r04) consumes 34.9
+images/s (JPEG decode -> bilinear resize to 400x400 -> ImageNet normalize
+-> collate); the IVD config at ~120 pairs/s needs ~240 images/s.
+
+This benchmark writes PF-Pascal-sized JPEGs to a temp dir, streams them
+through `ImagePairDataset` + `DataLoader` (batch 16, the training config),
+and reports steady-state images/s per worker count. Prints one JSON line
+per configuration.
+
+Run: python benchmarks/micro_loader.py [--n_images 64] [--n_batches 24]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def make_dataset_dir(root, n_images, seed=0):
+    """PF-Pascal-like JPEGs (typical source sizes ~300-500 px sides)."""
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    names = []
+    for i in range(n_images):
+        h = int(rng.randint(280, 500))
+        w = int(rng.randint(280, 500))
+        # low-frequency content so JPEG decode cost is realistic (pure
+        # noise images decode slower than natural images encode-wise but
+        # compress terribly; mix a gradient + noise)
+        gy, gx = np.mgrid[0:h, 0:w]
+        base = (
+            127
+            + 80 * np.sin(gx / 37.0 + i)
+            + 40 * np.cos(gy / 23.0)
+        )[..., None]
+        img = base + rng.randn(h, w, 3) * 12
+        name = f"img_{i:04d}.jpg"
+        Image.fromarray(
+            np.clip(img, 0, 255).astype(np.uint8)
+        ).save(os.path.join(root, name), quality=90)
+        names.append(name)
+    return names
+
+
+def write_pairs_csv(path, names, n_rows, seed=0):
+    rng = np.random.RandomState(seed)
+    with open(path, "w") as f:
+        f.write("source_image,target_image,class,flip\n")
+        for _ in range(n_rows):
+            a, b = rng.choice(len(names), 2, replace=False)
+            f.write(f"{names[a]},{names[b]},1,{rng.randint(2)}\n")
+
+
+def bench(workers, batch_size, n_batches, csv_path, img_dir,
+          backend="thread"):
+    from ncnet_tpu.data.loader import DataLoader
+    from ncnet_tpu.data.pairs import ImagePairDataset
+
+    ds = ImagePairDataset(csv_path, img_dir)
+    loader = DataLoader(
+        ds, batch_size, shuffle=True, num_workers=workers, drop_last=True,
+        backend=backend,
+    )
+    it = iter(loader)
+    # warmup: fill the prefetch window + page caches (+ spawn the pool)
+    for _ in range(2):
+        next(it)
+    t0 = time.perf_counter()
+    seen = 0
+    for _ in range(n_batches):
+        b = next(it)
+        seen += len(b["source_image"]) * 2  # two images per pair
+    dt = time.perf_counter() - t0
+    loader.close()
+    return seen / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n_images", type=int, default=64)
+    ap.add_argument("--n_batches", type=int, default=24)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--workers", type=int, nargs="*", default=[1, 2, 4, 8])
+    args = ap.parse_args()
+
+    with tempfile.TemporaryDirectory() as root:
+        names = make_dataset_dir(root, args.n_images)
+        csv_path = os.path.join(root, "pairs.csv")
+        # enough rows that n_batches never wraps
+        write_pairs_csv(
+            csv_path, names, max(4000, args.n_batches * args.batch * 2)
+        )
+        for backend in ("thread", "process"):
+            for w in args.workers:
+                rate = bench(
+                    w, args.batch, args.n_batches, csv_path, root, backend
+                )
+                print(json.dumps({
+                    "metric": "train_loader_images_per_sec",
+                    "backend": backend,
+                    "workers": w,
+                    "batch": args.batch,
+                    "value": round(rate, 1),
+                    "unit": "images/s",
+                    "device_demand_pfpascal": 34.9,
+                    "device_demand_ivd": 240.0,
+                    "keeps_up_pfpascal": rate > 34.9,
+                    "keeps_up_ivd": rate > 240.0,
+                }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

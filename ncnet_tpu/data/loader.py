@@ -4,15 +4,21 @@ Replaces the reference's vendored fork of the PyTorch-0.3 DataLoader
 (lib/dataloader.py:1-316, SURVEY.md §2 item 20). Design differences,
 TPU-host-first:
 
-* worker THREADS with a bounded prefetch window (at most
-  ``prefetch + num_workers`` batches in flight or buffered) instead of
-  forked processes
-  (decode/resize release the GIL in PIL/numpy; no shared-memory IPC needed
-  to feed a TPU — arrays go straight to `device_put`);
+* two worker backends behind one API: worker THREADS with a bounded
+  prefetch window (at most ``prefetch + num_workers`` batches in flight
+  or buffered), and worker PROCESSES (``backend='process'``) for rates
+  the GIL caps — measured on this host the thread backend plateaus at
+  ~40 images/s regardless of worker count (PIL decode + small-array
+  numpy ops serialize), enough for the PF-Pascal device rate (34.9
+  images/s at 17.4 pairs/s) but not the IVD config's ~240; the process
+  backend scales to ~190 at 8 workers (benchmarks/micro_loader.py,
+  PERF.md). The process pool is spawn-context (fork after jax import can
+  deadlock) with the dataset shipped once per worker at startup, not per
+  task;
 * the reference's one fix over stock torch — per-worker numpy RNG reseeding
   so augmentation isn't duplicated (lib/dataloader.py:39-43) — is preserved
   by construction: sample RNG is derived from the sample index, so results
-  are identical regardless of worker count;
+  are identical regardless of worker count AND backend;
 * deterministic epoch shuffling from a seed;
 * per-host sharding for multi-host data parallelism.
 """
@@ -23,6 +29,20 @@ import time
 import traceback
 
 import numpy as np
+
+# process-backend worker state: the dataset object, delivered once via the
+# pool initializer (pickling it per task would dominate small-task cost)
+_WORKER_DATASET = None
+
+
+def _process_worker_init(dataset):
+    global _WORKER_DATASET
+    _WORKER_DATASET = dataset
+
+
+def _process_build_batch(indices):
+    ds = _WORKER_DATASET
+    return collate([ds[int(i)] for i in indices])
 
 
 def collate(samples):
@@ -59,7 +79,10 @@ class DataLoader:
         prefetch=4,
         host_id=0,
         n_hosts=1,
+        backend="thread",
     ):
+        if backend not in ("thread", "process"):
+            raise ValueError(f"unknown loader backend {backend!r}")
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -69,6 +92,27 @@ class DataLoader:
         self.prefetch = prefetch
         self.indices = shard_indices(len(dataset), host_id, n_hosts)
         self.epoch = 0
+        self.backend = backend
+        self._pool = None
+
+    def _process_pool(self):
+        # lazily created, reused across epochs (spawn startup is ~1 s)
+        if self._pool is None:
+            import concurrent.futures
+            import multiprocessing
+
+            self._pool = concurrent.futures.ProcessPoolExecutor(
+                self.num_workers,
+                mp_context=multiprocessing.get_context("spawn"),
+                initializer=_process_worker_init,
+                initargs=(self.dataset,),
+            )
+        return self._pool
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.shutdown()
+            self._pool = None
 
     def __len__(self):
         n = len(self.indices)
@@ -89,7 +133,34 @@ class DataLoader:
         ]
         if self.drop_last and batches and len(batches[-1]) < self.batch_size:
             batches.pop()
+        if self.backend == "process":
+            return self._iter_process(batches)
+        return self._iter_thread(batches)
 
+    def _iter_process(self, batches):
+        import collections
+
+        pool = self._process_pool()
+        window = self.prefetch + self.num_workers
+        futs = collections.deque()
+        bi = 0
+        while bi < len(batches) or futs:
+            while bi < len(batches) and len(futs) < window:
+                futs.append(pool.submit(_process_build_batch, batches[bi]))
+                bi += 1
+            # same error contract as the thread backend: wrap the worker
+            # exception (its remote traceback rides along as __cause__).
+            # An abandoned iterator leaves at most `window` futures to
+            # drain quietly in the reused pool.
+            try:
+                batch = futs.popleft().result()
+            except BaseException as e:  # noqa: BLE001 — re-raised wrapped
+                raise RuntimeError(
+                    f"data worker failed on batch construction: {e!r}"
+                ) from e
+            yield batch
+
+    def _iter_thread(self, batches):
         task_q = queue.Queue()
         for bi, b in enumerate(batches):
             task_q.put((bi, b))

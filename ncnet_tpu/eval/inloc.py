@@ -200,7 +200,7 @@ def dump_matches(
     verbose=True,
     mesh=None,
     softmax=True,
-    device_preprocess=True,
+    device_preprocess=False,
 ):
     """Run the full dump. Writes ``<output_dir>/<q+1>.mat`` per query.
 
@@ -208,16 +208,24 @@ def dump_matches(
     pipeline over A-grid rows for resolutions beyond single-chip HBM. The
     resize quantization is widened so feature grids divide the shard count.
 
+    Crash safety: each ``.mat`` is written to a temp name in the output
+    dir and atomically renamed into place, so resume (which skips
+    existing files) can never trust a torn write; stale temp files from a
+    killed run are removed on start.
+
     Host pipeline engineering (round 4, measured): the per-pair wall clock
     was 10.75 s against 0.92 s of device time — dominated by fp32 image
     transfer over this platform's ~25 MB/s tunnel and serial host
-    decode+resize. Three fixes, all on by default (10.75 -> 3.82 s/pair,
-    benchmarks/PERF.md): images ship as uint8 with on-device
-    normalization (``device_preprocess``, 4x less H2D traffic); a
-    one-worker prefetch thread decodes+resizes the NEXT image while the
-    device computes the current pair; and the next image's
-    host->device copy is enqueued before synchronizing on the current
-    pair's result (`pre_transfer`), riding along the device compute.
+    decode+resize. The fixes (10.75 -> 3.82 s/pair, benchmarks/PERF.md):
+    images ship as uint8 with on-device normalization
+    (``device_preprocess`` — numerics differ from the exact host-fp32
+    path only by uint8 rounding of resized pixels, so the LIBRARY default
+    stays False and the CLI turns it on); a one-worker prefetch thread
+    decodes+resizes upcoming images while the device computes the current
+    pair; the next images' host->device copies are enqueued before
+    synchronizing on the current pair's result (`pre_transfer`, 2 deep —
+    round 5), riding along the device compute; and `savemat` compression
+    runs on a writer thread off the consume loop (round 5).
     """
     import concurrent.futures
 
@@ -248,6 +256,23 @@ def dump_matches(
             device_normalize=device_preprocess,
         )
 
+    # a killed run can leave torn temp files behind; they are never read
+    # by resume (only exact `<q+1>.mat` names are), just clean them up —
+    # but NOT temps owned by a still-running dump sharing this directory
+    # (a second resume process must not delete the first's in-flight file)
+    for stale in os.listdir(output_dir):
+        if ".mat.tmp." not in stale:
+            continue
+        try:
+            owner = int(stale.rsplit(".", 1)[-1])
+            os.kill(owner, 0)  # raises if no such process
+            continue  # owner alive: leave its temp alone
+        except (ValueError, ProcessLookupError):
+            pass
+        except PermissionError:
+            continue  # pid exists under another uid: leave it
+        os.unlink(os.path.join(output_dir, stale))
+
     # (root, fn) jobs for every missing pair, in dump order: queries are
     # interleaved with their panos so one prefetch slot always holds the
     # next image to be consumed
@@ -262,14 +287,31 @@ def dump_matches(
         for idx in range(n_panos):
             jobs.append((pano_path, _to_str(db[q][1].ravel()[idx])))
 
+    def atomic_savemat(out_path, payload):
+        # savemat into a temp name + atomic rename: resume treats any
+        # existing `<q+1>.mat` as complete, so a crash mid-write must
+        # never leave a file under the final name
+        tmp = f"{out_path}.tmp.{os.getpid()}"
+        try:
+            savemat(tmp, payload, do_compression=True)
+            os.replace(tmp, out_path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
     n_slots = n_match_slots(image_size, k_size, both_directions)
     import collections
 
-    with concurrent.futures.ThreadPoolExecutor(1) as pool:
+    with concurrent.futures.ThreadPoolExecutor(1) as pool, \
+            concurrent.futures.ThreadPoolExecutor(1) as writer:
         # bounded look-ahead: at most `window` decoded images in flight
         # on the host (so prefetch memory stays O(window), not O(dump))
-        # plus ONE image pre-transferred to the device
-        window = 3
+        # plus up to `device_ahead` images pre-transferred to the device
+        # (2-deep: one transfer can complete while a second streams over
+        # the ~25 MB/s tunnel during the current pair's compute)
+        window = 4
+        device_ahead = 2
         jobs_iter = iter(jobs)
         pending = collections.deque()
         yielded = 0
@@ -289,21 +331,26 @@ def dump_matches(
             yielded += 1
             return fut.result()
 
-        ahead = None  # next image, already ON the device
+        ahead = collections.deque()  # next images, already ON the device
 
         def take():
-            nonlocal ahead
-            if ahead is not None:
-                img, ahead = ahead, None
-                return img
+            if ahead:
+                return ahead.popleft()
             return jnp.asarray(next_image())
 
         def pre_transfer():
-            # enqueue the next image's host->device copy while the
+            # enqueue upcoming images' host->device copies while the
             # device is busy with the current pair
-            nonlocal ahead
-            if ahead is None and yielded < len(jobs):
-                ahead = jnp.asarray(next_image())
+            while len(ahead) < device_ahead and yielded < len(jobs):
+                ahead.append(jnp.asarray(next_image()))
+
+        writes = collections.deque()
+
+        def flush_writes(keep=1):
+            # propagate writer-thread failures promptly; keep at most
+            # `keep` outstanding so a wedged disk backpressures the loop
+            while writes and (len(writes) > keep or writes[0].done()):
+                writes.popleft().result()
 
         top_up()
         for q in todo:
@@ -327,11 +374,17 @@ def dump_matches(
                 matches[0, idx, :n, 4] = score[:n]
                 if idx + 1 < n_panos:
                     tgt = take()
-            savemat(
-                out_path,
-                {"matches": matches, "query_fn": query_fn,
-                 "pano_fn": pano_fn_all},
-                do_compression=True,
+            # compression is ~100 ms of host CPU per query; run it off
+            # the consume loop so the device never waits on it
+            writes.append(
+                writer.submit(
+                    atomic_savemat,
+                    out_path,
+                    {"matches": matches, "query_fn": query_fn,
+                     "pano_fn": pano_fn_all},
+                )
             )
+            flush_writes()
             if verbose:
                 print(f"query {q + 1}/{n_queries} -> {out_path}", flush=True)
+        flush_writes(keep=0)

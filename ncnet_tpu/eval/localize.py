@@ -134,17 +134,17 @@ def dlt_pnp(rays, points):
     if n < 6:
         return None
     f = rays / np.linalg.norm(rays, axis=1, keepdims=True)
+    Xh = np.concatenate([points, np.ones((n, 1))], axis=1)  # [n, 4]
     A = np.zeros((2 * n, 12))
-    for i in range(n):
-        X = np.append(points[i], 1.0)
-        x, y, z = f[i]
-        # two independent rows of [f]_x * [X' 0 0; 0 X' 0; 0 0 X'] P_vec
-        A[2 * i, 0:4] = -z * X
-        A[2 * i, 8:12] = x * X
-        A[2 * i + 1, 4:8] = -z * X
-        A[2 * i + 1, 8:12] = y * X
-    _, _, Vt = np.linalg.svd(A)
-    P = Vt[-1].reshape(3, 4)
+    # two independent rows of [f]_x * [X' 0 0; 0 X' 0; 0 0 X'] P_vec
+    A[0::2, 0:4] = -f[:, 2:3] * Xh
+    A[0::2, 8:12] = f[:, 0:1] * Xh
+    A[1::2, 4:8] = -f[:, 2:3] * Xh
+    A[1::2, 8:12] = f[:, 1:2] * Xh
+    # the LO refit can see thousands of inliers: the null vector via eigh
+    # of the 12x12 normal matrix costs O(n) instead of an O(n^2) full SVD
+    _, evec = np.linalg.eigh(A.T @ A)
+    P = evec[:, 0].reshape(3, 4)
     # The SVD null vector's sign is arbitrary; resolve it BEFORE the SO(3)
     # projection (the closest rotation to -sigma*R is unrelated to R — a
     # wrong pose in ~half of solves if skipped).
@@ -175,10 +175,159 @@ def _angular_inliers(P, unit_rays, points, cos_thr):
     return cosang > cos_thr
 
 
+def _p3p_grunert_batch(f, X):
+    """Vectorized `p3p_grunert` over ``B`` sampled triplets.
+
+    Args:
+      f: ``[B, 3, 3]`` UNIT bearing triplets (rows).
+      X: ``[B, 3, 3]`` world-point triplets (rows).
+
+    Returns:
+      ``(poses [M, 3, 4], owner [M])`` — all real admissible solutions
+      across the batch, with ``owner[m]`` the triplet index each pose came
+      from. Same math as the scalar path, batched: the quartic is solved
+      for the whole batch at once via companion-matrix eigenvalues
+      (np.roots is exactly this for one polynomial), and the final rigid
+      fits run through one batched SVD.
+    """
+    B = len(f)
+    a = np.linalg.norm(X[:, 1] - X[:, 2], axis=1)
+    b = np.linalg.norm(X[:, 0] - X[:, 2], axis=1)
+    c = np.linalg.norm(X[:, 0] - X[:, 1], axis=1)
+    cos_a = np.einsum("bi,bi->b", f[:, 1], f[:, 2])
+    cos_b = np.einsum("bi,bi->b", f[:, 0], f[:, 2])
+    cos_g = np.einsum("bi,bi->b", f[:, 0], f[:, 1])
+
+    with np.errstate(divide="ignore", invalid="ignore"):
+        a2, b2, c2 = a * a, b * b, c * c
+        q = (a2 - c2) / b2
+        A4 = (q - 1.0) ** 2 - 4.0 * (c2 / b2) * cos_a**2
+        A3 = 4.0 * (
+            q * (1.0 - q) * cos_b
+            - (1.0 - (a2 + c2) / b2) * cos_a * cos_g
+            + 2.0 * (c2 / b2) * cos_a**2 * cos_b
+        )
+        A2 = 2.0 * (
+            q**2
+            - 1.0
+            + 2.0 * q**2 * cos_b**2
+            + 2.0 * ((b2 - c2) / b2) * cos_a**2
+            - 4.0 * ((a2 + c2) / b2) * cos_a * cos_b * cos_g
+            + 2.0 * ((b2 - a2) / b2) * cos_g**2
+        )
+        A1 = 4.0 * (
+            -q * (1.0 + q) * cos_b
+            + 2.0 * (a2 / b2) * cos_g**2 * cos_b
+            - (1.0 - (a2 + c2) / b2) * cos_a * cos_g
+        )
+        A0 = (1.0 + q) ** 2 - 4.0 * (a2 / b2) * cos_g**2
+
+    coeffs = np.stack([A4, A3, A2, A1, A0], axis=1)
+    good = (
+        (np.minimum(np.minimum(a, b), c) > 1e-12)
+        & np.all(np.isfinite(coeffs), axis=1)
+        & (np.abs(A4) > 1e-14)
+    )
+    if not np.any(good):
+        return np.zeros((0, 3, 4)), np.zeros(0, int)
+    idx = np.nonzero(good)[0]
+    cf = coeffs[idx]
+    # batched np.roots: monic companion matrices, one eig call
+    mono = cf[:, 1:] / cf[:, :1]
+    comp = np.zeros((len(idx), 4, 4))
+    comp[:, 1, 0] = comp[:, 2, 1] = comp[:, 3, 2] = 1.0
+    comp[:, 0, :] = -mono
+    roots = np.linalg.eigvals(comp)  # [G, 4] complex
+
+    G = len(idx)
+    v = roots.real  # [G, 4]
+    real_pos = (np.abs(roots.imag) <= 1e-8) & (v > 0)
+    cos_ab = cos_a[idx][:, None]
+    cos_bb = cos_b[idx][:, None]
+    cos_gb = cos_g[idx][:, None]
+    qb = q[idx][:, None]
+    b2b = b2[idx][:, None]
+    with np.errstate(divide="ignore", invalid="ignore"):
+        denom = 2.0 * (cos_gb - v * cos_ab)
+        u = ((qb - 1.0) * v * v - 2.0 * qb * cos_bb * v + 1.0 + qb) / denom
+        s1sq = b2b / (1.0 + v * v - 2.0 * v * cos_bb)
+    ok = (
+        real_pos
+        & (np.abs(denom) > 1e-12)
+        & (u > 0)
+        & (s1sq > 0)
+        & np.isfinite(u)
+        & np.isfinite(s1sq)
+    )
+    gi, ri = np.nonzero(ok)
+    if len(gi) == 0:
+        return np.zeros((0, 3, 4)), np.zeros(0, int)
+    owner = idx[gi]
+    s1 = np.sqrt(s1sq[gi, ri])
+    s2 = u[gi, ri] * s1
+    s3 = v[gi, ri] * s1
+    cam = np.stack(
+        [
+            s1[:, None] * f[owner, 0],
+            s2[:, None] * f[owner, 1],
+            s3[:, None] * f[owner, 2],
+        ],
+        axis=1,
+    )  # [M, 3, 3]
+    P = _absolute_orientation_batch(X[owner], cam)
+    keep = np.all(np.isfinite(P.reshape(len(P), -1)), axis=1)
+    return P[keep], owner[keep]
+
+
+def _absolute_orientation_batch(world_pts, cam_pts):
+    """Batched Kabsch: ``[M, 3, 3]`` point triplets -> ``[M, 3, 4]`` poses."""
+    cw = world_pts.mean(axis=1, keepdims=True)
+    cc = cam_pts.mean(axis=1, keepdims=True)
+    H = np.einsum("mki,mkj->mij", world_pts - cw, cam_pts - cc)
+    U, _, Vt = np.linalg.svd(H)
+    d = np.sign(np.linalg.det(np.einsum("mji,mkj->mik", Vt, U)))
+    Vt_adj = Vt.copy()
+    Vt_adj[:, 2, :] *= d[:, None]
+    R = np.einsum("mji,mkj->mik", Vt_adj, U)
+    t = cc[:, 0] - np.einsum("mij,mj->mi", R, cw[:, 0])
+    return np.concatenate([R, t[:, :, None]], axis=2)
+
+
+def _count_inliers_batch(P, unit_rays, points, cos_thr):
+    """Inlier counts for ``[M, 3, 4]`` poses at once: the RANSAC scoring
+    loop as one batched BLAS matmul instead of M small matmuls (einsum
+    measured 6x slower here — it doesn't dispatch to BLAS)."""
+    Xc = np.matmul(points, P[:, :, :3].transpose(0, 2, 1))
+    Xc += P[:, None, :, 3]  # [M, n, 3]
+    dots = (Xc * unit_rays).sum(axis=2)
+    sq = (Xc * Xc).sum(axis=2)
+    if cos_thr > 0:
+        # cos > thr  <=>  dot > thr * ||Xc||: sign-safe both sides at
+        # tight angular thresholds, avoids the divide + sqrt
+        return (
+            (dots > 0) & (dots * dots > cos_thr * cos_thr * sq)
+        ).sum(axis=1)
+    # wide thresholds (>= 90 deg, reachable via pnp_thr_deg): exact path
+    with np.errstate(divide="ignore", invalid="ignore"):
+        cosang = dots / np.sqrt(sq)
+    cosang[~np.isfinite(cosang)] = -1.0
+    return (cosang > cos_thr).sum(axis=1)
+
+
 def lo_ransac_p3p(rays, points, thr_rad, max_iters=10000, seed=0,
-                  confidence=0.999):
+                  confidence=0.999, chunk=128):
     """Locally-optimized RANSAC over P3P (the ``ht_lo_ransac_p3p`` role:
     parfor_NC4D_PE_pnponly.m:77).
+
+    Hypotheses are generated and scored in vectorized chunks (round 5):
+    one batched quartic solve + one einsum inlier count per ``chunk``
+    samples instead of a Python loop per hypothesis — 30-40x faster at
+    the reference's 10k-iteration budget (benchmarks/micro_localize.py).
+    The adaptive stopping rule is applied between chunks, so at most
+    ``chunk - 1`` extra hypotheses are drawn vs the serial schedule.
+    Local optimization (DLT refit on inliers) runs on the chunk's best
+    candidate only when it improves on the incumbent, like the serial
+    version.
 
     Args:
       rays: ``[n, 3]`` camera-frame bearing vectors.
@@ -199,24 +348,58 @@ def lo_ransac_p3p(rays, points, thr_rad, max_iters=10000, seed=0,
     rays = rays / np.linalg.norm(rays, axis=1, keepdims=True)
     best_P, best_inl = None, empty
     it, needed = 0, max_iters
+
+    def local_optimize(P0, inl0):
+        # refit on inliers, re-collect, keep while improving
+        best_P, best_inl = P0, inl0
+        for _ in range(2):
+            if best_inl.sum() < 6:
+                break
+            P_lo = dlt_pnp(rays[best_inl], points[best_inl])
+            if P_lo is None:
+                break
+            inl_lo = _angular_inliers(P_lo, rays, points, cos_thr)
+            if inl_lo.sum() >= best_inl.sum():
+                best_P, best_inl = P_lo, inl_lo
+            else:
+                break
+        return best_P, best_inl
+
+    # candidate pre-scoring runs on a subsample when the tentative set is
+    # large (counts only rank candidates within a chunk; the winner is
+    # re-scored exactly before it can displace the incumbent)
+    if n > 4000:
+        sub = rng.permutation(n)[:2000]
+        score_rays, score_pts = rays[sub], points[sub]
+    else:
+        score_rays, score_pts = rays, points
+    scale = n / len(score_pts)
+
     while it < min(max_iters, needed):
-        it += 1
-        sel = rng.choice(n, 3, replace=False)
-        for P in p3p_grunert(rays[sel], points[sel]):
-            inl = _angular_inliers(P, rays, points, cos_thr)
+        m = min(chunk, min(max_iters, needed) - it)
+        it += m
+        # m index-triplets; duplicate-containing rows are resampled (the
+        # collision probability is ~3/n, so this loop runs ~once)
+        sel = rng.randint(0, n, (m, 3))
+        while True:
+            dup = (
+                (sel[:, 0] == sel[:, 1])
+                | (sel[:, 0] == sel[:, 2])
+                | (sel[:, 1] == sel[:, 2])
+            )
+            if not dup.any():
+                break
+            sel[dup] = rng.randint(0, n, (int(dup.sum()), 3))
+        cand_P, _ = _p3p_grunert_batch(rays[sel], points[sel])
+        if len(cand_P) == 0:
+            continue
+        counts = _count_inliers_batch(cand_P, score_rays, score_pts, cos_thr)
+        bi = int(np.argmax(counts))
+        if counts[bi] * scale > best_inl.sum() * 0.5:
+            # promising: exact count, then the serial acceptance test
+            inl = _angular_inliers(cand_P[bi], rays, points, cos_thr)
             if inl.sum() > best_inl.sum():
-                best_P, best_inl = P, inl
-                # local optimization: refit on inliers, re-collect
-                for _ in range(2):
-                    if best_inl.sum() >= 6:
-                        P_lo = dlt_pnp(rays[best_inl], points[best_inl])
-                        if P_lo is None:
-                            break
-                        inl_lo = _angular_inliers(P_lo, rays, points, cos_thr)
-                        if inl_lo.sum() >= best_inl.sum():
-                            best_P, best_inl = P_lo, inl_lo
-                        else:
-                            break
+                best_P, best_inl = local_optimize(cand_P[bi], inl)
                 w = best_inl.sum() / n
                 if w > 0:
                     denom = np.log(max(1.0 - w**3, 1e-12))

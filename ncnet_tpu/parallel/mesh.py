@@ -106,15 +106,33 @@ def make_hybrid_mesh(per_host_shape=None, axis_names=("data",)):
     n_proc = jax.process_count()
     if n_proc == 1:
         return make_mesh(per_host_shape, axis_names)
-    from jax.experimental import mesh_utils
 
     local = jax.local_device_count()
     if per_host_shape is None:
         per_host_shape = (local,)
-    dev = mesh_utils.create_hybrid_device_mesh(
-        mesh_shape=per_host_shape,
-        dcn_mesh_shape=(n_proc,) + (1,) * (len(per_host_shape) - 1),
-    )  # shape: (n_proc * per_host_shape[0], *per_host_shape[1:])
+
+    # create_hybrid_device_mesh keys the DCN dimension on `slice_index`,
+    # which only TPU slices carry — multi-process CPU clusters (and
+    # single-slice multi-host setups) present as one slice and make it
+    # raise (found by tests/test_multihost.py, the first time this branch
+    # truly executed). Use it when slice attribution exists; otherwise
+    # group by process_index, which is the same "leading axis crosses
+    # DCN, trailing axes stay within a host" placement.
+    slices = {getattr(d, "slice_index", None) for d in jax.devices()}
+    if len(slices) == n_proc and None not in slices:
+        from jax.experimental import mesh_utils
+
+        dev = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=per_host_shape,
+            dcn_mesh_shape=(n_proc,) + (1,) * (len(per_host_shape) - 1),
+        )  # shape: (n_proc * per_host_shape[0], *per_host_shape[1:])
+        return Mesh(dev, axis_names)
+
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    dev = np.asarray(devs).reshape((n_proc,) + tuple(per_host_shape))
+    dev = dev.reshape(
+        (n_proc * per_host_shape[0],) + tuple(per_host_shape[1:])
+    )
     return Mesh(dev, axis_names)
 
 

@@ -187,7 +187,10 @@ def main():
     if args.workers > 1:
         import multiprocessing
 
-        pool = multiprocessing.Pool(args.workers)
+        # 'spawn', not the default fork: the parent imports jax (via
+        # eval.inloc._to_str), and forking after the XLA backend starts
+        # threads can deadlock workers (advisor finding, round 4)
+        pool = multiprocessing.get_context("spawn").Pool(args.workers)
         # contiguous chunks keep each worker on NEIGHBORING queries,
         # whose top-10 shortlists overlap heavily — that locality is
         # what the per-worker load_cutout/load_alignment caches need

@@ -70,6 +70,11 @@ def main():
     p.add_argument("--result_model_dir", type=str, default="trained_models")
     p.add_argument("--result_model_fn", type=str, default="ncnet_tpu.msgpack")
     p.add_argument("--num_workers", type=int, default=4)
+    p.add_argument("--loader_backend", choices=("thread", "process"),
+                   default="thread",
+                   help="data-loader worker backend; 'process' scales past "
+                        "the GIL's ~40 images/s ceiling (measured: the IVD "
+                        "config consumes ~240 images/s — PERF.md)")
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("--bf16", action="store_true", help="bfloat16 compute path")
     p.add_argument("--profile_dir", type=str, default="",
@@ -277,12 +282,12 @@ def main():
     train_loader = DataLoader(
         train_ds, local_bs, shuffle=True, seed=args.seed,
         num_workers=args.num_workers, drop_last=True,
-        host_id=host_id, n_hosts=n_hosts,
+        host_id=host_id, n_hosts=n_hosts, backend=args.loader_backend,
     )
     val_loader = DataLoader(
         val_ds, local_bs, shuffle=False,
         num_workers=args.num_workers, drop_last=True,
-        host_id=host_id, n_hosts=n_hosts,
+        host_id=host_id, n_hosts=n_hosts, backend=args.loader_backend,
     )
 
     train(

@@ -47,6 +47,84 @@ def _fake_resnet_state_dict(prefix="FeatureExtraction.model."):
     return sd
 
 
+def _fake_vgg_state_dict(prefix="FeatureExtraction.model."):
+    """Reference-style vgg checkpoint keys: torchvision ``features``
+    Sequential indices under ``FeatureExtraction.model.`` (conv layers at
+    0,2,5,7,... with ReLU/pool gaps — lib/model.py:24-35)."""
+    from ncnet_tpu.models.vgg import VGG16_TO_POOL4
+
+    g = torch.Generator().manual_seed(4)
+    sd = {}
+    cin, idx = 3, 0
+    for c in VGG16_TO_POOL4:
+        if c == "M":
+            idx += 1  # pool occupies one Sequential slot
+            continue
+        sd[f"{prefix}{idx}.weight"] = torch.randn(c, cin, 3, 3, generator=g)
+        sd[f"{prefix}{idx}.bias"] = torch.randn(c, generator=g)
+        cin = c
+        idx += 2  # conv + its ReLU
+    return sd
+
+
+def test_vgg_checkpoint_conversion(tmp_path):
+    """Reference-schema vgg .pth.tar: the converter must read the arch from
+    the embedded args, map the Sequential-index keys in order, and produce
+    a tree identical in structure to init_vgg16_trunk."""
+    import argparse
+
+    from ncnet_tpu.models.vgg import init_vgg16_trunk, vgg16_trunk_apply
+
+    sd = _fake_vgg_state_dict()
+    g = torch.Generator().manual_seed(5)
+    w0 = torch.randn(16, 1, 3, 3, 3, 3, generator=g).permute(2, 0, 1, 3, 4, 5)
+    w1 = torch.randn(1, 16, 3, 3, 3, 3, generator=g).permute(2, 0, 1, 3, 4, 5)
+    sd["NeighConsensus.conv.0.weight"] = w0.contiguous()
+    sd["NeighConsensus.conv.0.bias"] = torch.randn(16, generator=g)
+    sd["NeighConsensus.conv.2.weight"] = w1.contiguous()
+    sd["NeighConsensus.conv.2.bias"] = torch.randn(1, generator=g)
+
+    args = argparse.Namespace(
+        ncons_kernel_sizes=[3, 3],
+        ncons_channels=[16, 1],
+        feature_extraction_cnn="vgg",
+    )
+    path = str(tmp_path / "ref_vgg.pth.tar")
+    torch.save({"state_dict": sd, "args": args, "epoch": 5}, path)
+
+    config, params = convert_torch.convert_checkpoint(path)
+    assert config.feature_extraction_cnn == "vgg"
+    ref = init_vgg16_trunk(jax.random.PRNGKey(0))
+    ref_flat, ref_tree = jax.tree.flatten(ref)
+    got_flat, got_tree = jax.tree.flatten(params["feature_extraction"])
+    assert ref_tree == got_tree
+    for a, b in zip(ref_flat, got_flat):
+        assert np.shape(a) == np.shape(b)
+    # converted weights must match the source values layer-by-layer, in
+    # features order (sorted numerically, not lexically: index 10 > 2)
+    np.testing.assert_allclose(
+        np.asarray(params["feature_extraction"][2]["kernel"]),
+        sd["FeatureExtraction.model.5.weight"].numpy().transpose(2, 3, 1, 0),
+    )
+    out = vgg16_trunk_apply(
+        [{k: jnp.asarray(v) for k, v in p.items()} for p in params["feature_extraction"]],
+        jnp.zeros((1, 32, 32, 3), jnp.float32),
+    )
+    assert out.shape == (1, 2, 2, 512)
+
+
+def test_load_trunk_weights_vgg_raw_torchvision(tmp_path):
+    """A raw torchvision vgg16 state dict (``features.N.weight`` keys, as
+    downloaded from the zoo) loads through load_trunk_weights."""
+    sd = _fake_vgg_state_dict(prefix="features.")
+    path = str(tmp_path / "vgg16_zoo.pth")
+    torch.save(sd, path)
+    params = convert_torch.load_trunk_weights(path, cnn="vgg")
+    assert len(params) == 10
+    assert params[0]["kernel"].shape == (3, 3, 3, 64)
+    assert params[-1]["kernel"].shape == (3, 3, 512, 512)
+
+
 def test_resnet_conversion_structure_matches_init():
     sd = _fake_resnet_state_dict()
     converted = convert_torch.convert_resnet101_trunk(sd)

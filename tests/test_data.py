@@ -155,6 +155,54 @@ def test_loader_surfaces_worker_exception_fast():
     assert time.time() - t0 < 1.0
 
 
+def test_loader_process_backend_matches_thread():
+    """The spawn-context process pool (the GIL-escape backend for rates
+    the IVD config needs — PERF.md) must yield byte-identical batches in
+    the same order as the thread backend, across epochs."""
+    ds = SyntheticPairDataset(n=12, output_size=(16, 16))
+    thread = DataLoader(ds, 4, shuffle=True, seed=3, num_workers=2)
+    proc = DataLoader(
+        ds, 4, shuffle=True, seed=3, num_workers=2, backend="process"
+    )
+    try:
+        for _ in range(2):  # two epochs: the pool is reused
+            bt = list(thread)
+            bp = list(proc)
+            assert len(bt) == len(bp) == 3
+            for b1, b2 in zip(bt, bp):
+                np.testing.assert_array_equal(
+                    b1["source_image"], b2["source_image"]
+                )
+    finally:
+        proc.close()
+
+
+class _PoisonedDataset:
+    """Module-level (spawn workers must pickle the dataset by reference)."""
+
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, idx):
+        if idx == 2:
+            raise ValueError("poisoned sample 2")
+        return {"x": np.zeros((2,), np.float32)}
+
+
+def test_loader_process_backend_surfaces_exception():
+    loader = DataLoader(
+        _PoisonedDataset(), 2, num_workers=2, backend="process"
+    )
+    try:
+        # same error contract as the thread backend: RuntimeError wrapper
+        # naming the original exception
+        with pytest.raises(RuntimeError, match="poisoned sample 2"):
+            for _ in loader:
+                pass
+    finally:
+        loader.close()
+
+
 def test_collate():
     out = collate([{"a": np.zeros((2, 2), np.float32)}, {"a": np.ones((2, 2), np.float32)}])
     assert out["a"].shape == (2, 2, 2)

@@ -163,6 +163,71 @@ def test_dump_matches_contract(tiny, tmp_path):
     assert (np.abs(out["matches"][0, 1]).sum() > 0)
 
 
+def test_dump_matches_crash_safe_resume(tiny, tmp_path, monkeypatch):
+    """A crash mid-savemat must not leave a file resume would trust: the
+    write goes to a temp name + atomic rename (round-4 weakness #6), and
+    stale temp files from a killed run are cleaned up on start."""
+    import scipy.io
+
+    from PIL import Image
+    from scipy.io import loadmat, savemat
+
+    from ncnet_tpu.eval.inloc import dump_matches
+
+    rng = np.random.RandomState(5)
+    qdir, pdir = tmp_path / "query", tmp_path / "pano"
+    qdir.mkdir()
+    pdir.mkdir()
+    Image.fromarray(rng.randint(0, 255, (70, 60, 3), np.uint8)).save(
+        qdir / "q0.png"
+    )
+    Image.fromarray(rng.randint(0, 255, (70, 60, 3), np.uint8)).save(
+        pdir / "p0.png"
+    )
+    dt = np.dtype([("queryname", object), ("topN", object)])
+    entry = np.zeros((1, 1), dt)
+    entry[0, 0] = (
+        np.array(["q0.png"], object),
+        np.array([["p0.png"]], object),
+    )
+    savemat(tmp_path / "shortlist.mat", {"ImgList": entry})
+
+    out_dir = tmp_path / "matches"
+    out_dir.mkdir()
+    stale = out_dir / "1.mat.tmp.999"
+    stale.write_bytes(b"torn write from a killed run")
+
+    kw = dict(
+        shortlist_path=str(tmp_path / "shortlist.mat"),
+        query_path=str(qdir),
+        pano_path=str(pdir),
+        output_dir=str(out_dir),
+        image_size=64,
+        n_queries=1,
+        n_panos=1,
+        verbose=False,
+    )
+
+    real_savemat = scipy.io.savemat
+
+    def crashing_savemat(path, *a, **k):
+        real_savemat(path, *a, **k)  # the bytes DID hit the temp file
+        raise OSError("simulated crash mid-write")
+
+    cfg = TINY.replace(relocalization_k_size=1)
+    monkeypatch.setattr(scipy.io, "savemat", crashing_savemat)
+    with pytest.raises(OSError, match="simulated crash"):
+        dump_matches(tiny, cfg, **kw)
+    assert not (out_dir / "1.mat").exists()  # resume can't see a torn file
+    assert not stale.exists()  # stale temp cleaned on start
+    assert list(out_dir.iterdir()) == []  # and no new temp left behind
+
+    monkeypatch.setattr(scipy.io, "savemat", real_savemat)
+    dump_matches(tiny, cfg, **kw)  # resume completes the query
+    out = loadmat(out_dir / "1.mat")
+    assert out["matches"].shape[0:2] == (1, 1)
+
+
 def test_device_preprocess_matches_host_path(tiny, tmp_path):
     """The uint8 + on-device-normalize dump path (round 4, a 4x H2D
     saving on tunneled hosts) must agree with the host-fp32 path to

@@ -102,6 +102,9 @@ def test_localize_cli_writes_json_errors_and_curve(tmp_path):
             "--refposes", str(tmp_path / "refposes.mat"),
             "--out", str(out_json),
             "--method", "testm",
+            # exercise the multiprocess-PnP parfor analog; the pool uses
+            # the 'spawn' context (fork after jax import can deadlock)
+            "--workers", "2",
         ],
         capture_output=True,
         text=True,

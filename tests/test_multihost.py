@@ -1,0 +1,195 @@
+"""Real 2-process jax.distributed cluster test (SURVEY.md §2.3).
+
+Round-4 gap: `initialize_multihost`'s real branch (jax.distributed init +
+per-process global-array assembly in `shard_batch`) only ever ran as a
+single-process no-op; the 8-device dryrun lives in ONE process. Here the
+multi-host path actually executes: two child interpreters (the
+`__graft_entry__.py` child-env technique) each with 2 virtual CPU devices
+join a coordinator, build the hybrid DCN-aware mesh, assemble the global
+batch from process-local slices with `jax.make_array_from_process_local_data`,
+and run one data-parallel train step. Both processes must agree on the
+psum-reduced loss, and it must match a single-process run of the same
+global batch on a 4-device mesh computed in the parent (this suite's
+conftest already forces the CPU backend, so the parent is safe to compute
+the oracle in-process).
+"""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+GRID_DEVICES = 4  # 2 processes x 2 local devices
+LOCAL_DEVICES = 2
+IMAGE = 32
+
+_LOSS_RE = re.compile(r"MHLOSS (\S+) procs=(\d+) devices=(\d+)")
+
+
+def _global_batch():
+    rng = np.random.RandomState(7)
+    return {
+        "source_image": rng.randn(GRID_DEVICES, IMAGE, IMAGE, 3).astype(
+            np.float32
+        ),
+        "target_image": rng.randn(GRID_DEVICES, IMAGE, IMAGE, 3).astype(
+            np.float32
+        ),
+    }
+
+
+def _config():
+    from ncnet_tpu.models.immatchnet import ImMatchNetConfig
+
+    return ImMatchNetConfig(ncons_kernel_sizes=(3, 3), ncons_channels=(4, 1))
+
+
+def _child_main():
+    """Runs inside each spawned process; prints the step loss."""
+    import jax
+
+    # Same load-bearing guard as __graft_entry__: the JAX_PLATFORMS env var
+    # is ignored when this image's TPU plugin is present.
+    jax.config.update("jax_platforms", "cpu")
+
+    coordinator = os.environ["_NCNET_MH_COORD"]
+    pid = int(os.environ["_NCNET_MH_PID"])
+
+    from ncnet_tpu.models.immatchnet import init_immatchnet
+    from ncnet_tpu.parallel.mesh import (
+        initialize_multihost,
+        make_hybrid_mesh,
+        replicate,
+        shard_batch,
+    )
+    from ncnet_tpu.train.step import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    process_index, process_count = initialize_multihost(
+        coordinator_address=coordinator, num_processes=2, process_id=pid
+    )
+    assert (process_index, process_count) == (pid, 2), (
+        process_index,
+        process_count,
+    )
+    assert jax.device_count() == GRID_DEVICES
+    assert jax.local_device_count() == LOCAL_DEVICES
+
+    mesh = make_hybrid_mesh()
+    assert mesh.shape == {"data": GRID_DEVICES}
+
+    config = _config()
+    params = init_immatchnet(jax.random.PRNGKey(0), config)
+    optimizer = make_optimizer()
+    state = create_train_state(replicate(mesh, params), optimizer)
+    state = state._replace(opt_state=replicate(mesh, state.opt_state))
+
+    # Each process feeds ONLY its host-local slice of the global batch —
+    # the multi-host contract of shard_batch. The hybrid mesh maps the
+    # leading axis across processes in process order.
+    full = _global_batch()
+    lo, hi = pid * LOCAL_DEVICES, (pid + 1) * LOCAL_DEVICES
+    local = {k: v[lo:hi] for k, v in full.items()}
+    batch = shard_batch(mesh, local)
+
+    step = make_train_step(config, optimizer, donate=False)
+    new_state, loss = step(state, batch)
+    jax.block_until_ready(loss)
+    assert int(new_state.step) == 1
+    print(
+        f"MHLOSS {float(loss):.10e} procs={jax.process_count()} "
+        f"devices={jax.device_count()}",
+        flush=True,
+    )
+
+
+def test_two_process_cluster_matches_single_process():
+    port = _free_port()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        os.environ.get("XLA_FLAGS", ""),
+    ).strip()
+    procs = []
+    for pid in range(2):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS=(
+                flags
+                + f" --xla_force_host_platform_device_count={LOCAL_DEVICES}"
+            ).strip(),
+            _NCNET_MH_COORD=f"localhost:{port}",
+            _NCNET_MH_PID=str(pid),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)],
+                cwd=repo,
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=280)
+        outs.append(out)
+        assert p.returncode == 0, f"multihost child failed:\n{out}"
+
+    losses = []
+    for out in outs:
+        m = _LOSS_RE.search(out)
+        assert m, f"no MHLOSS line in child output:\n{out}"
+        assert (int(m.group(2)), int(m.group(3))) == (2, GRID_DEVICES)
+        losses.append(float(m.group(1)))
+    # the loss is psum-reduced and replicated: both processes see the same
+    assert losses[0] == losses[1], losses
+
+    # single-process oracle on a 4-device mesh over the same global batch
+    import jax
+
+    from ncnet_tpu.models.immatchnet import init_immatchnet
+    from ncnet_tpu.parallel.mesh import make_mesh, replicate, shard_batch
+    from ncnet_tpu.train.step import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    config = _config()
+    mesh = make_mesh(devices=jax.devices()[:GRID_DEVICES])
+    params = init_immatchnet(jax.random.PRNGKey(0), config)
+    optimizer = make_optimizer()
+    state = create_train_state(replicate(mesh, params), optimizer)
+    state = state._replace(opt_state=replicate(mesh, state.opt_state))
+    batch = shard_batch(mesh, _global_batch())
+    _, want = make_train_step(config, optimizer, donate=False)(state, batch)
+    # random-init loss is ~1e-6 (score_neg - score_pos near zero), so the
+    # comparison needs an absolute floor: cross-process psum vs in-process
+    # reduction order differ by O(1 ulp) = ~3e-8 here
+    np.testing.assert_allclose(losses[0], float(want), rtol=1e-5, atol=1e-6)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+if __name__ == "__main__":
+    # `python tests/test_multihost.py` puts tests/ (not the repo root) at
+    # sys.path[0]; the child needs the ncnet_tpu package importable
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    _child_main()
