@@ -107,6 +107,7 @@ CONFIGS = {
         # measured-best per-layer mix at the 5^4 shapes (PERF.md)
         "impl": "tlc//btl,btl4,tlc/tlc/tf3",
         "metric": "train_pairs_per_sec_per_chip_400px_resnet101",
+        "loss_chunk": 8,
         "v100_est": 4.0,
         "v100_bounds": (2.4, 6.5),
     },
@@ -118,6 +119,9 @@ CONFIGS = {
         # XLA's own transposes is fastest on both layers
         "impl": "tlc,tlc",
         "metric": "train_pairs_per_sec_per_chip_400px_resnet101_ivd",
+        # chunk 4 beats 8 here (125.7/125.9 vs 120.8/121.4 across reruns);
+        # 2 and 16 fall to ~92 (benchmarks/ivd_sweep*.log)
+        "loss_chunk": 4,
         "v100_est": 35.0,
         "v100_bounds": (19.0, 64.0),
     },
@@ -163,7 +167,9 @@ def main():
                    help="re-enable per-chunk rematerialization (the r2-r3 "
                         "regime; a net loss since the composite VJPs "
                         "shrank the un-remat'd residuals — see PERF.md)")
-    p.add_argument("--loss_chunk", type=int, default=8)
+    p.add_argument("--loss_chunk", type=int, default=None,
+                   help="default: the measured-best chunk for --config "
+                        "(pfpascal 8, ivd 4)")
     p.add_argument("--sym_seq", action="store_true",
                    help="run the symmetric NC passes sequentially instead "
                         "of double-batched (halves stack live memory)")
@@ -186,6 +192,10 @@ def main():
 
     preset = CONFIGS[args.config]
     impl = args.conv4d_impl if args.conv4d_impl is not None else preset["impl"]
+    loss_chunk = (
+        args.loss_chunk if args.loss_chunk is not None
+        else preset["loss_chunk"]
+    )
     batch_size = args.batch
     config = ImMatchNetConfig(
         ncons_kernel_sizes=preset["kernels"],
@@ -193,7 +203,7 @@ def main():
         half_precision=True,  # bf16 correlation/NC path (TPU-native)
         conv4d_impl=impl,
         nc_remat=args.nc_remat,
-        loss_chunk=args.loss_chunk,
+        loss_chunk=loss_chunk,
         loss_chunk_remat=args.chunk_remat,
         symmetric_batch=not args.sym_seq,
     )

@@ -1,0 +1,160 @@
+"""Host-pipeline wall clock of the InLoc dump at real image sizes.
+
+Reproduces the round-4 "mini dump" measurement (PERF.md "Host pipeline")
+against the current `dump_matches`: uint8 H2D + on-device normalize,
+decode-prefetch thread, 2-deep device pre-transfer, and the round-5
+atomic+async `.mat` writer. Synthetic JPEGs at the real InLoc sizes
+(queries 4032x3024, panos 1600x1200 — both land in the single (2400,
+3200) resize bucket), randomized NC weights; the timing is host-pipeline
+bound, not accuracy-relevant.
+
+Run: python benchmarks/micro_dump.py [--queries 6] [--panos 2]
+Prints one JSON line (steady-state s/pair, excluding the first query,
+whose pairs pay the XLA compiles).
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def make_fixture(root, n_queries, n_panos, seed=0):
+    from PIL import Image
+    from scipy.io import savemat
+
+    rng = np.random.RandomState(seed)
+    qdir = os.path.join(root, "query")
+    pdir = os.path.join(root, "pano")
+    os.makedirs(qdir)
+    os.makedirs(pdir)
+
+    def save_jpg(path, h, w):
+        gy, gx = np.mgrid[0:h, 0:w]
+        base = (127 + 70 * np.sin(gx / 41.0) + 30 * np.cos(gy / 29.0))[
+            ..., None
+        ]
+        img = np.clip(base + rng.randn(h, w, 3) * 10, 0, 255).astype(
+            np.uint8
+        )
+        Image.fromarray(img).save(path, quality=85)
+
+    pano_names = []
+    for i in range(n_panos * 2):
+        name = f"p{i}.jpg"
+        save_jpg(os.path.join(pdir, name), 1200, 1600)
+        pano_names.append(name)
+
+    dt = np.dtype([("queryname", object), ("topN", object)])
+    entries = np.zeros((1, n_queries), dt)
+    for q in range(n_queries):
+        qname = f"q{q}.jpg"
+        save_jpg(os.path.join(qdir, qname), 3024, 4032)
+        top = rng.choice(pano_names, n_panos, replace=False)
+        entries[0, q] = (
+            np.array([qname], object),
+            np.array([[t] for t in top], object),
+        )
+    savemat(os.path.join(root, "shortlist.mat"), {"ImgList": entries})
+    return qdir, pdir, os.path.join(root, "shortlist.mat")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--queries", type=int, default=6)
+    ap.add_argument("--panos", type=int, default=2)
+    ap.add_argument("--image_size", type=int, default=3200)
+    ap.add_argument("--conv4d_impl", default="cfs")
+    ap.add_argument("--host_fp32", action="store_true",
+                    help="time the exact host-normalize path instead of "
+                         "the uint8 device-preprocess default of the CLI")
+    args = ap.parse_args()
+
+    import jax
+
+    from ncnet_tpu.eval.inloc import dump_matches
+    from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+
+    config = ImMatchNetConfig(
+        ncons_kernel_sizes=(3, 3),
+        ncons_channels=(16, 1),
+        half_precision=True,
+        relocalization_k_size=2,
+        conv4d_impl=args.conv4d_impl,
+        symmetric_batch=False,
+    )
+    params = init_immatchnet(jax.random.PRNGKey(0), config)
+
+    with tempfile.TemporaryDirectory() as root:
+        qdir, pdir, shortlist = make_fixture(
+            root, args.queries, args.panos
+        )
+        out_dir = os.path.join(root, "matches")
+
+        times = []
+        t_all = time.perf_counter()
+
+        class Tick:
+            """Wall-clock per query via the verbose print hook."""
+
+        # warm + steady in one pass: time each query by wrapping print
+        t_prev = [time.perf_counter()]
+
+        real_print = print
+
+        def timed_dump():
+            dump_matches(
+                params,
+                config,
+                shortlist_path=shortlist,
+                query_path=qdir,
+                pano_path=pdir,
+                output_dir=out_dir,
+                image_size=args.image_size,
+                n_queries=args.queries,
+                n_panos=args.panos,
+                verbose=True,
+                device_preprocess=not args.host_fp32,
+            )
+
+        import builtins
+
+        def hook(*a, **k):
+            now = time.perf_counter()
+            times.append(now - t_prev[0])
+            t_prev[0] = now
+            real_print(*a, **k)
+
+        builtins.print, saved = hook, builtins.print
+        try:
+            timed_dump()
+        finally:
+            builtins.print = saved
+
+        total = time.perf_counter() - t_all
+        # first query pays the compiles; steady state = the rest
+        steady = times[1:]
+        s_per_pair = float(np.mean(steady)) / args.panos if steady else None
+        print(json.dumps({
+            "metric": "inloc_dump_s_per_pair_steady",
+            "value": round(s_per_pair, 3) if s_per_pair else None,
+            "unit": "s",
+            "first_query_s": round(times[0], 1) if times else None,
+            "queries": args.queries,
+            "panos_per_query": args.panos,
+            "total_s": round(total, 1),
+            "device_preprocess": not args.host_fp32,
+            "projected_356x10_h": round(
+                356 * 10 * s_per_pair / 3600.0, 2
+            ) if s_per_pair else None,
+        }))
+
+
+if __name__ == "__main__":
+    main()

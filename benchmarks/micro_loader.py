@@ -110,6 +110,7 @@ def main():
                 print(json.dumps({
                     "metric": "train_loader_images_per_sec",
                     "backend": backend,
+                    "host_cores": os.cpu_count(),
                     "workers": w,
                     "batch": args.batch,
                     "value": round(rate, 1),

@@ -6,15 +6,18 @@ TPU-host-first:
 
 * two worker backends behind one API: worker THREADS with a bounded
   prefetch window (at most ``prefetch + num_workers`` batches in flight
-  or buffered), and worker PROCESSES (``backend='process'``) for rates
-  the GIL caps — measured on this host the thread backend plateaus at
-  ~40 images/s regardless of worker count (PIL decode + small-array
-  numpy ops serialize), enough for the PF-Pascal device rate (34.9
-  images/s at 17.4 pairs/s) but not the IVD config's ~240; the process
-  backend scales to ~190 at 8 workers (benchmarks/micro_loader.py,
-  PERF.md). The process pool is spawn-context (fork after jax import can
-  deadlock) with the dataset shipped once per worker at startup, not per
-  task;
+  or buffered), and worker PROCESSES (``backend='process'``) for
+  multi-core hosts where the GIL would cap the rate. Measured
+  (benchmarks/micro_loader.py, PERF.md): one image costs ~14.6 ms of
+  host CPU (decode 1.4 + resize 10.7 + normalize 2.5), so a core
+  sustains ~68 images/s and the loader ~45 after collate/queue overhead
+  — this container exposes ONE core, so that is its ceiling under
+  either backend (the process pool only adds IPC there). It covers the
+  PF-Pascal device rate (34.9 images/s at 17.4 pairs/s); the IVD
+  config's ~240 images/s needs ~5+ cores with the process backend —
+  trivial on real TPU hosts (v5e hosts expose >100 vCPUs). The pool is
+  spawn-context (fork after jax import can deadlock) with the dataset
+  shipped once per worker at startup, not per task;
 * the reference's one fix over stock torch — per-worker numpy RNG reseeding
   so augmentation isn't duplicated (lib/dataloader.py:39-43) — is preserved
   by construction: sample RNG is derived from the sample index, so results
@@ -152,9 +155,12 @@ class DataLoader:
             # exception (its remote traceback rides along as __cause__).
             # An abandoned iterator leaves at most `window` futures to
             # drain quietly in the reused pool.
+            # Exception, not BaseException: a KeyboardInterrupt here hits
+            # the MAIN thread mid-wait and must keep its own semantics;
+            # worker failures always arrive as Exception via the future
             try:
                 batch = futs.popleft().result()
-            except BaseException as e:  # noqa: BLE001 — re-raised wrapped
+            except Exception as e:
                 raise RuntimeError(
                     f"data worker failed on batch construction: {e!r}"
                 ) from e

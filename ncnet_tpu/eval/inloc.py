@@ -124,7 +124,10 @@ def make_match_fn(config, mesh=None, softmax=True, device_preprocess=False):
         )
         fwd = corr_to_matches(corr, **kw)
         rev = corr_to_matches(corr, invert_matching_direction=True, **kw)
-        return fwd, rev
+        # one device buffer per direction (not 5): each D2H transfer pays
+        # this platform's ~80 ms dispatch latency, so the dump loop reads
+        # ONE stacked [5, b, n] array per direction instead of five
+        return jnp.stack(fwd), jnp.stack(rev)
 
     return fn
 
@@ -155,13 +158,15 @@ def match_pair(match_fn, params, src, tgt, k_size, stride=16,
     fs2 = src.shape[2] // stride // k
     fs3 = tgt.shape[1] // stride // k
     fs4 = tgt.shape[2] // stride // k
+    # each direction is ONE stacked [5, b, n] device array (make_match_fn);
+    # concatenating on device keeps the host sync to a single transfer
     if both_directions:
-        parts = [np.asarray(jnp.concatenate([a, b], axis=1)) for a, b in zip(fwd, rev)]
+        parts = np.asarray(jnp.concatenate([fwd, rev], axis=2))
     elif flip_direction:
-        parts = [np.asarray(v) for v in rev]
+        parts = np.asarray(rev)
     else:
-        parts = [np.asarray(v) for v in fwd]
-    xa, ya, xb, yb, score = [p[0] for p in parts]
+        parts = np.asarray(fwd)
+    xa, ya, xb, yb, score = parts[:, 0]
 
     if both_directions:
         order = np.argsort(-score)  # descending; keeps max-score dup first
@@ -271,7 +276,10 @@ def dump_matches(
             pass
         except PermissionError:
             continue  # pid exists under another uid: leave it
-        os.unlink(os.path.join(output_dir, stale))
+        try:
+            os.unlink(os.path.join(output_dir, stale))
+        except FileNotFoundError:
+            pass  # a concurrent starter already cleaned it
 
     # (root, fn) jobs for every missing pair, in dump order: queries are
     # interleaved with their panos so one prefetch slot always holds the

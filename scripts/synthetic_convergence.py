@@ -155,6 +155,11 @@ def main():
                         "synthetic task (kept for the record)")
     p.add_argument("--ncons_kernel_sizes", nargs="+", type=int, default=[3, 3])
     p.add_argument("--ncons_channels", nargs="+", type=int, default=[16, 1])
+    p.add_argument("--json_out", default="",
+                   help="write the run metrics (loss trajectory, PCK "
+                        "before/after, degenerate baseline) as JSON")
+    p.add_argument("--plot_out", default="",
+                   help="write a loss-curve + PCK figure (PNG)")
     args = p.parse_args()
     out = run(
         image_size=args.image_size,
@@ -177,6 +182,39 @@ def main():
         and out["pck_after"] > out["pck_before"]
         and out["pck_after"] > out["pck_diagonal_baseline"]
     )
+    if args.json_out:
+        import json
+
+        metrics = {k: v for k, v in out.items()
+                   if k not in ("params", "config")}
+        metrics.update(
+            convergence_ok=ok, steps=args.steps, alpha=args.alpha,
+            image_size=args.image_size, fe_arch=args.fe_arch,
+            nc_init=args.nc_init, seed=args.seed,
+        )
+        with open(args.json_out, "w") as f:
+            json.dump(metrics, f, indent=1)
+        print(f"wrote {args.json_out}")
+    if args.plot_out:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(9, 3.2))
+        ax1.plot(out["losses"], lw=0.8)
+        ax1.set_xlabel("step")
+        ax1.set_ylabel("weak loss")
+        ax1.set_title("training loss")
+        bars = [out["pck_before"], out["pck_after"],
+                out["pck_diagonal_baseline"]]
+        ax2.bar(["before", "after", "degenerate\nbaseline"], bars,
+                color=["#999", "#2a6", "#c66"])
+        ax2.set_ylim(0, 1.05)
+        ax2.set_title(f"transfer PCK@{args.alpha}")
+        fig.tight_layout()
+        fig.savefig(args.plot_out, dpi=120)
+        print(f"wrote {args.plot_out}")
     print(f"convergence {'OK' if ok else 'NOT DEMONSTRATED'}")
     sys.exit(0 if ok else 1)
 

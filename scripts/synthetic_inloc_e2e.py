@@ -387,10 +387,19 @@ def main():
     p.add_argument("--bf16_check", action="store_true",
                    help="also dump through the bf16 pipeline and report "
                         "the fp32-vs-bf16 pose disagreement")
+    p.add_argument("--json_out", default="",
+                   help="write the summary metrics as JSON (the committed-"
+                        "artifact form of the chain's results)")
     args = p.parse_args()
     os.makedirs(args.out_dir, exist_ok=True)
-    run(args.out_dir, steps=args.steps, train_size=args.train_size,
-        seed=args.seed, bf16_check=args.bf16_check)
+    summary = run(args.out_dir, steps=args.steps, train_size=args.train_size,
+                  seed=args.seed, bf16_check=args.bf16_check)
+    if args.json_out:
+        summary = dict(summary, steps=args.steps, seed=args.seed,
+                       train_size=args.train_size)
+        with open(args.json_out, "w") as f:
+            json.dump(summary, f, indent=1)
+        print(f"wrote {args.json_out}")
 
 
 if __name__ == "__main__":

@@ -72,9 +72,10 @@ def main():
     p.add_argument("--num_workers", type=int, default=4)
     p.add_argument("--loader_backend", choices=("thread", "process"),
                    default="thread",
-                   help="data-loader worker backend; 'process' scales past "
-                        "the GIL's ~40 images/s ceiling (measured: the IVD "
-                        "config consumes ~240 images/s — PERF.md)")
+                   help="data-loader worker backend; on multi-core hosts "
+                        "'process' scales past the GIL (one core decodes "
+                        "~68 images/s; the IVD config consumes ~240 — "
+                        "PERF.md)")
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("--bf16", action="store_true", help="bfloat16 compute path")
     p.add_argument("--profile_dir", type=str, default="",

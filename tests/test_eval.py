@@ -194,7 +194,15 @@ def test_dump_matches_crash_safe_resume(tiny, tmp_path, monkeypatch):
 
     out_dir = tmp_path / "matches"
     out_dir.mkdir()
-    stale = out_dir / "1.mat.tmp.999"
+    # a guaranteed-DEAD owner pid: spawn and reap a child (pid 999 or any
+    # literal could be a live process on a full host, and the cleanup
+    # correctly leaves live owners' temps alone)
+    import subprocess
+
+    child = subprocess.Popen(["true"])
+    child.wait()
+    dead_pid = child.pid
+    stale = out_dir / f"1.mat.tmp.{dead_pid}"
     stale.write_bytes(b"torn write from a killed run")
 
     kw = dict(
