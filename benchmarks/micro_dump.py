@@ -22,7 +22,7 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def make_fixture(root, n_queries, n_panos, seed=0):
@@ -100,10 +100,8 @@ def main():
         times = []
         t_all = time.perf_counter()
 
-        class Tick:
-            """Wall-clock per query via the verbose print hook."""
-
-        # warm + steady in one pass: time each query by wrapping print
+        # warm + steady in one pass: time each query by intercepting the
+        # consume loop's per-query progress line through a print hook
         t_prev = [time.perf_counter()]
 
         real_print = print
@@ -126,9 +124,12 @@ def main():
         import builtins
 
         def hook(*a, **k):
-            now = time.perf_counter()
-            times.append(now - t_prev[0])
-            t_prev[0] = now
+            # only the consume loop's "query N/M -> path" lines mark a
+            # query boundary; any other print passes through untimed
+            if a and isinstance(a[0], str) and a[0].startswith("query "):
+                now = time.perf_counter()
+                times.append(now - t_prev[0])
+                t_prev[0] = now
             real_print(*a, **k)
 
         builtins.print, saved = hook, builtins.print

@@ -64,6 +64,14 @@ def resize_bilinear_np(image, out_h, out_w):
     return out
 
 
+def to_uint8_image(image):
+    """Rounded uint8 of a [0, 255]-range float image — the wire format of
+    the device-preprocess paths (train's ``uint8_output`` loader option
+    and eval's ``device_normalize``); one definition so train and eval
+    quantization can never diverge."""
+    return np.rint(np.clip(image, 0.0, 255.0)).astype(np.uint8)
+
+
 def normalize_image_np(image):
     """0..255 float RGB -> ImageNet-normalized (in place when possible)."""
     return (image / 255.0 - IMAGENET_MEAN) / IMAGENET_STD

@@ -16,7 +16,12 @@ import os
 
 import numpy as np
 
-from ncnet_tpu.data.images import load_image, normalize_image_np, resize_bilinear_np
+from ncnet_tpu.data.images import (
+    load_image,
+    normalize_image_np,
+    resize_bilinear_np,
+    to_uint8_image,
+)
 
 PF_PASCAL_CATEGORIES = (
     "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
@@ -46,12 +51,21 @@ class ImagePairDataset:
         random_crop=False,
         normalize=True,
         seed=0,
+        uint8_output=False,
     ):
+        """``uint8_output=True`` returns resized images as uint8 WITHOUT
+        normalization — 4x less host->device traffic; the train step
+        ImageNet-normalizes uint8 batches on device (train/loss.py).
+        Numerics differ from the host path only by uint8 rounding of the
+        resized pixels."""
+        if uint8_output and normalize:
+            normalize = False
         self.header, self.rows = _read_csv(csv_file)
         self.dataset_path = dataset_path
         self.out_h, self.out_w = output_size
         self.random_crop = random_crop
         self.normalize = normalize
+        self.uint8_output = uint8_output
         self.seed = seed
 
     def __len__(self):
@@ -71,6 +85,8 @@ class ImagePairDataset:
         if flip:
             img = img[:, ::-1]
         img = resize_bilinear_np(img, self.out_h, self.out_w)
+        if self.uint8_output:
+            return to_uint8_image(img)
         if self.normalize:
             img = normalize_image_np(img)
         return img

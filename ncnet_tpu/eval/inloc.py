@@ -26,7 +26,12 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ncnet_tpu.data.images import load_image, normalize_image_np, resize_bilinear_np
+from ncnet_tpu.data.images import (
+    load_image,
+    normalize_image_np,
+    resize_bilinear_np,
+    to_uint8_image,
+)
 from ncnet_tpu.models.feature_extraction import backbone_stride
 from ncnet_tpu.models.immatchnet import immatchnet_apply
 from ncnet_tpu.ops.matches import corr_to_matches
@@ -76,7 +81,7 @@ def load_and_preprocess(path, image_size, k_size, grid_multiple=None,
     )
     img = resize_bilinear_np(img, h, w)
     if device_normalize:
-        return np.rint(np.clip(img, 0.0, 255.0)).astype(np.uint8)[None]
+        return to_uint8_image(img)[None]
     return normalize_image_np(img)[None]  # [1, h, w, 3]
 
 

@@ -72,8 +72,17 @@ def weak_loss(params, config, batch, normalization="softmax"):
             "(the reference trains with relocalization_k_size=0; "
             "relocalization is an eval-time memory optimization)"
         )
-    feat_a = extract_features(params, config, batch["source_image"])
-    feat_b = extract_features(params, config, batch["target_image"])
+    src, tgt = batch["source_image"], batch["target_image"]
+    if src.dtype == jnp.uint8:
+        # uint8 batches ship 4x less host->device traffic (the loader's
+        # uint8_output path); ImageNet normalization then runs on device —
+        # dtype is static under jit, so this branch costs nothing
+        from ncnet_tpu.ops.image import imagenet_normalize
+
+        src = imagenet_normalize(src.astype(jnp.float32))
+        tgt = imagenet_normalize(tgt.astype(jnp.float32))
+    feat_a = extract_features(params, config, src)
+    feat_b = extract_features(params, config, tgt)
     feat_a_neg = jnp.roll(feat_a, -1, axis=0)
     nc_params = params["neigh_consensus"]
 

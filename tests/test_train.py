@@ -52,6 +52,60 @@ def test_weak_loss_finite_and_grad_nonzero():
     assert gnorm > 0
 
 
+def test_weak_loss_uint8_batch_matches_host_normalized():
+    """A uint8 batch (the loader's ``uint8_output`` 4x-H2D-saving path)
+    must produce the same loss as host-side ImageNet normalization of the
+    same integer pixels — the on-device normalize in weak_loss is keyed
+    on batch dtype."""
+    from ncnet_tpu.data.images import normalize_image_np
+
+    params = init_immatchnet(jax.random.PRNGKey(0), CFG)
+    rng = np.random.RandomState(7)
+    u8 = {
+        "source_image": rng.randint(0, 256, (4, 64, 64, 3)).astype(np.uint8),
+        "target_image": rng.randint(0, 256, (4, 64, 64, 3)).astype(np.uint8),
+    }
+    host = {
+        k: jnp.asarray(
+            np.stack([normalize_image_np(img.astype(np.float32))
+                      for img in v])
+        )
+        for k, v in u8.items()
+    }
+    dev = {k: jnp.asarray(v) for k, v in u8.items()}
+    l_host = float(weak_loss(params, CFG, host))
+    l_dev = float(weak_loss(params, CFG, dev))
+    np.testing.assert_allclose(l_dev, l_host, rtol=1e-5, atol=1e-6)
+
+
+def test_image_pair_dataset_uint8_output():
+    """uint8_output returns rounded resized pixels, dtype uint8."""
+    import tempfile
+
+    from PIL import Image
+
+    from ncnet_tpu.data.pairs import ImagePairDataset
+
+    with tempfile.TemporaryDirectory() as root:
+        rng = np.random.RandomState(0)
+        for n in ("a.png", "b.png"):
+            Image.fromarray(
+                rng.randint(0, 255, (50, 40, 3), np.uint8)
+            ).save(f"{root}/{n}")
+        with open(f"{root}/pairs.csv", "w") as f:
+            f.write("source_image,target_image,class,flip\na.png,b.png,1,0\n")
+        ds8 = ImagePairDataset(f"{root}/pairs.csv", root,
+                               output_size=(32, 32), uint8_output=True)
+        ds32 = ImagePairDataset(f"{root}/pairs.csv", root,
+                                output_size=(32, 32), normalize=False)
+        s8, s32 = ds8[0], ds32[0]
+        assert s8["source_image"].dtype == np.uint8
+        np.testing.assert_allclose(
+            s8["source_image"].astype(np.float32),
+            np.rint(np.clip(s32["source_image"], 0, 255)),
+        )
+
+
 def test_train_step_updates_only_head():
     params = init_immatchnet(jax.random.PRNGKey(0), CFG)
     opt = make_optimizer(1e-3)
