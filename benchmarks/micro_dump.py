@@ -2,8 +2,8 @@
 
 Reproduces the round-4 "mini dump" measurement (PERF.md "Host pipeline")
 against the current `dump_matches`: uint8 H2D + on-device normalize,
-decode-prefetch thread, 2-deep device pre-transfer, and the round-5
-atomic+async `.mat` writer. Synthetic JPEGs at the real InLoc sizes
+decode-prefetch thread, 4-deep device pre-transfer, single stacked D2H
+per direction, and the round-5 atomic+async `.mat` writer. Synthetic JPEGs at the real InLoc sizes
 (queries 4032x3024, panos 1600x1200 — both land in the single (2400,
 3200) resize bucket), randomized NC weights; the timing is host-pipeline
 bound, not accuracy-relevant.

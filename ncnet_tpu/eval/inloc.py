@@ -232,10 +232,15 @@ def dump_matches(
     path only by uint8 rounding of resized pixels, so the LIBRARY default
     stays False and the CLI turns it on); a one-worker prefetch thread
     decodes+resizes upcoming images while the device computes the current
-    pair; the next images' host->device copies are enqueued before
-    synchronizing on the current pair's result (`pre_transfer`, 2 deep —
-    round 5), riding along the device compute; and `savemat` compression
-    runs on a writer thread off the consume loop (round 5).
+    pair; upcoming images' host->device copies are enqueued before
+    synchronizing on the current pair's result (`pre_transfer`, 4 deep —
+    the measured optimum: 2-deep 1.9-2.5 s/pair, 4-deep 1.37-1.43,
+    6-deep no better, benchmarks/micro_dump.py), riding along the device
+    compute; the per-pair readout is ONE stacked [5, b, n] D2H per
+    direction (each transfer pays ~80 ms dispatch latency here); and
+    `savemat` compression runs on a writer thread off the consume loop
+    (round 5). Net: 10.75 (r3) -> 3.82 (r4) -> ~1.4 s/pair (r5) on the
+    tunneled host; device-bound 0.92 on direct-attached hosts.
     """
     import concurrent.futures
 
@@ -321,10 +326,10 @@ def dump_matches(
         # bounded look-ahead: at most `window` decoded images in flight
         # on the host (so prefetch memory stays O(window), not O(dump))
         # plus up to `device_ahead` images pre-transferred to the device
-        # (2-deep: one transfer can complete while a second streams over
-        # the ~25 MB/s tunnel during the current pair's compute)
-        window = 4
-        device_ahead = 2
+        # (4-deep measured best: enough transfers in flight to keep the
+        # ~25 MB/s tunnel busy through the current pair's compute)
+        window = 6
+        device_ahead = 4
         jobs_iter = iter(jobs)
         pending = collections.deque()
         yielded = 0

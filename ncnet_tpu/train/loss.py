@@ -73,14 +73,18 @@ def weak_loss(params, config, batch, normalization="softmax"):
             "relocalization is an eval-time memory optimization)"
         )
     src, tgt = batch["source_image"], batch["target_image"]
-    if src.dtype == jnp.uint8:
+    if src.dtype == jnp.uint8 or tgt.dtype == jnp.uint8:
         # uint8 batches ship 4x less host->device traffic (the loader's
         # uint8_output path); ImageNet normalization then runs on device —
-        # dtype is static under jit, so this branch costs nothing
+        # dtype is static under jit, so this branch costs nothing. Each
+        # image is keyed on its OWN dtype: a mixed batch (hand-built
+        # loader) must not double-normalize the float half.
         from ncnet_tpu.ops.image import imagenet_normalize
 
-        src = imagenet_normalize(src.astype(jnp.float32))
-        tgt = imagenet_normalize(tgt.astype(jnp.float32))
+        if src.dtype == jnp.uint8:
+            src = imagenet_normalize(src.astype(jnp.float32))
+        if tgt.dtype == jnp.uint8:
+            tgt = imagenet_normalize(tgt.astype(jnp.float32))
     feat_a = extract_features(params, config, src)
     feat_b = extract_features(params, config, tgt)
     feat_a_neg = jnp.roll(feat_a, -1, axis=0)
