@@ -74,6 +74,10 @@ def main():
     ap.add_argument("--host_fp32", action="store_true",
                     help="time the exact host-normalize path instead of "
                          "the uint8 device-preprocess default of the CLI")
+    ap.add_argument("--no_device_resize", action="store_true",
+                    help="disable the on-device pano upscale (ship the "
+                         "host-resized 23 MB bucket image instead of the "
+                         "5.8 MB original)")
     args = ap.parse_args()
 
     import jax
@@ -119,6 +123,7 @@ def main():
                 n_panos=args.panos,
                 verbose=True,
                 device_preprocess=not args.host_fp32,
+                device_resize=not (args.host_fp32 or args.no_device_resize),
             )
 
         import builtins
@@ -151,6 +156,7 @@ def main():
             "panos_per_query": args.panos,
             "total_s": round(total, 1),
             "device_preprocess": not args.host_fp32,
+            "device_resize": not (args.host_fp32 or args.no_device_resize),
             "projected_356x10_h": round(
                 356 * 10 * s_per_pair / 3600.0, 2
             ) if s_per_pair else None,

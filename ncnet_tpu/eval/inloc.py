@@ -63,7 +63,7 @@ def quantized_resize_shape(h, w, image_size, k_size, grid_multiple=None):
 
 
 def load_and_preprocess(path, image_size, k_size, grid_multiple=None,
-                        device_normalize=False):
+                        device_normalize=False, device_resize=False):
     """Load -> quantized resize -> ImageNet-normalize.
 
     ``device_normalize=True`` returns the resized image as uint8 and
@@ -74,19 +74,54 @@ def load_and_preprocess(path, image_size, k_size, grid_multiple=None,
     attached TPU hosts both are microseconds and the paths are
     numerically equivalent to within the uint8 rounding of the resized
     pixels (<=0.2% of the dynamic range, far below matching tolerance).
+
+    ``device_resize=True`` (requires ``device_normalize``) changes the
+    RETURN TYPE to ``(uint8 [1,h,w,3], target_hw_or_None)``: when the
+    quantized resize would UPSCALE the image (InLoc's 1600x1200 panos
+    blow up 4x to the (2400, 3200) bucket, reference eval_inloc.py:84-89),
+    the ORIGINAL pixels are returned with the target shape and the
+    bilinear resize happens on device (`device_resize_uint8`), cutting
+    the dominant per-pair host->device transfer from ~23 MB to ~5.8 MB.
+    Downscales keep the host resize (the resized image is the smaller
+    wire format there) and return ``(resized uint8, None)``.
     """
     img = load_image(path)
     h, w = quantized_resize_shape(
         img.shape[0], img.shape[1], image_size, k_size, grid_multiple
     )
+    if device_resize:
+        assert device_normalize, "device_resize requires device_normalize"
+        if h * w > img.shape[0] * img.shape[1]:  # upscale: ship original
+            return to_uint8_image(img)[None], (h, w)
+        return to_uint8_image(resize_bilinear_np(img, h, w))[None], None
     img = resize_bilinear_np(img, h, w)
     if device_normalize:
         return to_uint8_image(img)[None]
     return normalize_image_np(img)[None]  # [1, h, w, 3]
 
 
-def make_match_fn(config, mesh=None, softmax=True, device_preprocess=False):
+def _device_resize_uint8(img, out_h, out_w):
+    from ncnet_tpu.ops.image import resize_bilinear_align_corners
+
+    out = resize_bilinear_align_corners(img.astype(jnp.float32), out_h, out_w)
+    return jnp.rint(jnp.clip(out, 0.0, 255.0)).astype(jnp.uint8)
+
+
+# jitted with static output shape; uint8 in -> uint8 out so downstream
+# (on-device ImageNet normalize) is identical to the host-resize path —
+# the only numerics delta is float-order rounding at rint boundaries
+# (<=1 gray level on a vanishing fraction of pixels, tested)
+device_resize_uint8 = jax.jit(_device_resize_uint8, static_argnums=(1, 2))
+
+
+def make_match_fn(config, mesh=None, softmax=True, device_preprocess=False,
+                  concat_directions=False):
     """(params, src, tgt) -> (fwd, rev) match tuples for one pair (jittable).
+
+    ``concat_directions=True`` (the both-directions dump's mode) returns
+    ONE ``[5, b, n_fwd + n_rev]`` array instead of the (fwd, rev) pair —
+    the direction concat moves inside the jit, saving a separate device
+    dispatch per pair (each costs ~80 ms over this platform's tunnel).
 
     With ``mesh`` (a Mesh with a 'spatial' axis), the correlation/NC
     pipeline runs sharded over the A-grid rows via
@@ -132,6 +167,8 @@ def make_match_fn(config, mesh=None, softmax=True, device_preprocess=False):
         # one device buffer per direction (not 5): each D2H transfer pays
         # this platform's ~80 ms dispatch latency, so the dump loop reads
         # ONE stacked [5, b, n] array per direction instead of five
+        if concat_directions:
+            return jnp.concatenate([jnp.stack(fwd), jnp.stack(rev)], axis=2)
         return jnp.stack(fwd), jnp.stack(rev)
 
     return fn
@@ -145,32 +182,47 @@ def recenter(coord, n_cells):
 
 def match_pair(match_fn, params, src, tgt, k_size, stride=16,
                both_directions=True, flip_direction=False, dedup=True,
-               precomputed=None):
+               precomputed=None, shapes=None):
     """Returns (xA, yA, xB, yB, score) numpy arrays for one image pair.
 
-    ``precomputed``: optionally the (fwd, rev) device output of an
-    earlier (asynchronously dispatched) ``match_fn`` call — lets callers
-    overlap the next pair's host->device transfer with this pair's
-    device compute before this function synchronizes on the result.
+    ``precomputed``: optionally the device output of an earlier
+    (asynchronously dispatched) ``match_fn`` call — lets callers overlap
+    the next pair's host->device transfer (and, with the pipelined dump
+    loop, the next pair's whole compute) with this pair's readout. Either
+    the (fwd, rev) tuple or, from a ``concat_directions`` match fn, the
+    single combined ``[5, b, n]`` array (implies ``both_directions``).
+
+    ``shapes``: optional ``(src_shape, tgt_shape)`` standing in for
+    ``src.shape``/``tgt.shape`` — lets a pipelined caller drop the device
+    image references while the pair's readout is still in flight.
     """
-    fwd, rev = (
+    src_shape, tgt_shape = shapes if shapes else (src.shape, tgt.shape)
+    k = max(k_size, 1)
+    # pooled correlation grid dims, derived from the image shapes
+    fs1 = src_shape[1] // stride // k
+    fs2 = src_shape[2] // stride // k
+    fs3 = tgt_shape[1] // stride // k
+    fs4 = tgt_shape[2] // stride // k
+    out = (
         precomputed if precomputed is not None
         else match_fn(params, src, tgt)
     )
-    k = max(k_size, 1)
-    # pooled correlation grid dims, derived from the image shapes
-    fs1 = src.shape[1] // stride // k
-    fs2 = src.shape[2] // stride // k
-    fs3 = tgt.shape[1] // stride // k
-    fs4 = tgt.shape[2] // stride // k
-    # each direction is ONE stacked [5, b, n] device array (make_match_fn);
-    # concatenating on device keeps the host sync to a single transfer
-    if both_directions:
-        parts = np.asarray(jnp.concatenate([fwd, rev], axis=2))
-    elif flip_direction:
-        parts = np.asarray(rev)
+    if isinstance(out, (tuple, list)):
+        fwd, rev = out
+        # each direction is ONE stacked [5, b, n] device array
+        # (make_match_fn); concatenating on device keeps the host sync to
+        # a single transfer
+        if both_directions:
+            parts = np.asarray(jnp.concatenate([fwd, rev], axis=2))
+        elif flip_direction:
+            parts = np.asarray(rev)
+        else:
+            parts = np.asarray(fwd)
     else:
-        parts = np.asarray(fwd)
+        # a `concat_directions` match fn (live or precomputed): already
+        # the combined [5, b, n] array
+        assert both_directions, "combined output implies both_directions"
+        parts = np.asarray(out)
     xa, ya, xb, yb, score = parts[:, 0]
 
     if both_directions:
@@ -211,6 +263,7 @@ def dump_matches(
     mesh=None,
     softmax=True,
     device_preprocess=False,
+    device_resize=False,
 ):
     """Run the full dump. Writes ``<output_dir>/<q+1>.mat`` per query.
 
@@ -223,29 +276,40 @@ def dump_matches(
     existing files) can never trust a torn write; stale temp files from a
     killed run are removed on start.
 
-    Host pipeline engineering (round 4, measured): the per-pair wall clock
-    was 10.75 s against 0.92 s of device time — dominated by fp32 image
-    transfer over this platform's ~25 MB/s tunnel and serial host
-    decode+resize. The fixes (10.75 -> 3.82 s/pair, benchmarks/PERF.md):
+    Host pipeline engineering (rounds 4-5, measured): the per-pair wall
+    clock started at 10.75 s against <1 s of device time — dominated by
+    fp32 image transfer over this platform's ~25 MB/s tunnel and serial
+    host decode+resize. The fixes (10.75 -> 0.61 s/pair,
+    benchmarks/PERF.md "Host pipeline"):
     images ship as uint8 with on-device normalization
     (``device_preprocess`` — numerics differ from the exact host-fp32
     path only by uint8 rounding of resized pixels, so the LIBRARY default
-    stays False and the CLI turns it on); a one-worker prefetch thread
-    decodes+resizes upcoming images while the device computes the current
-    pair; upcoming images' host->device copies are enqueued before
-    synchronizing on the current pair's result (`pre_transfer`, 4 deep —
-    the measured optimum: 2-deep 1.9-2.5 s/pair, 4-deep 1.37-1.43,
-    6-deep no better, benchmarks/micro_dump.py), riding along the device
-    compute; the per-pair readout is ONE stacked [5, b, n] D2H per
-    direction (each transfer pays ~80 ms dispatch latency here); and
-    `savemat` compression runs on a writer thread off the consume loop
-    (round 5). Net: 10.75 (r3) -> 3.82 (r4) -> ~1.4 s/pair (r5) on the
-    tunneled host; device-bound 0.92 on direct-attached hosts.
+    stays False and the CLI turns it on); upscale-bound images (the
+    panos: 1600x1200 -> the 2400x3200 bucket) ship at ORIGINAL size and
+    bilinear-resize on device (``device_resize``, 23 -> 5.8 MB per pair);
+    a one-worker prefetch thread decodes upcoming images while the
+    device computes the current pair; upcoming images' host->device
+    copies are enqueued before the current pair's result is consumed
+    (`pre_transfer`, 4 deep), riding along the device compute; the
+    per-pair readout is ONE concatenated [5, b, n] array whose direction
+    concat happens inside the jit (every extra dispatch/transfer pays
+    ~80 ms latency here) and whose D2H starts via `copy_to_host_async`
+    the moment compute finishes; the consume loop runs one pair BEHIND
+    the dispatch loop so readout+sort+dedup of pair i overlap the device
+    compute of pair i+1; and `savemat` compression runs on a writer
+    thread off the consume loop. Net measured steady state: 10.75 (r3)
+    -> 3.82 (r4) -> 0.61 s/pair (r5) on the tunneled host — A/B: without
+    ``device_resize`` the same pipeline is 1.54 s/pair (H2D-bound).
     """
     import concurrent.futures
 
     from scipy.io import loadmat, savemat
 
+    if device_resize and not device_preprocess:
+        raise ValueError(
+            "device_resize requires device_preprocess (the uint8 wire "
+            "format + on-device ImageNet normalization)"
+        )
     k_size = config.relocalization_k_size
     assert backbone_stride(config.feature_extraction_cnn) == int(1 / SCALE_FACTOR)
     grid_multiple = None
@@ -257,19 +321,27 @@ def dump_matches(
     pano_fn_all = np.vstack(tuple(db[q][1] for q in range(len(db))))
 
     os.makedirs(output_dir, exist_ok=True)
+    # both-directions dumps fuse the direction concat into the jit (one
+    # device dispatch less per pair) and pipeline the consume loop one
+    # pair deep below
+    concat = both_directions
     jitted = jax.jit(
         make_match_fn(
             config, mesh=mesh, softmax=softmax,
             device_preprocess=device_preprocess,
+            concat_directions=concat,
         )
     )
     stride = backbone_stride(config.feature_extraction_cnn)
 
     def prep(root, fn):
-        return load_and_preprocess(
+        out = load_and_preprocess(
             os.path.join(root, fn), image_size, k_size, grid_multiple,
             device_normalize=device_preprocess,
+            device_resize=device_resize,
         )
+        # uniform (array, target_hw_or_None) item shape for the loop
+        return out if device_resize else (out, None)
 
     # a killed run can leave torn temp files behind; they are never read
     # by resume (only exact `<q+1>.mat` names are), just clean them up —
@@ -351,16 +423,27 @@ def dump_matches(
 
         ahead = collections.deque()  # next images, already ON the device
 
+        def to_device(item):
+            # transfer (async) + optional on-device upscale to the bucket
+            # shape (`device_resize` — the resize rides the device queue,
+            # so pre-transferred images are already final-shaped by the
+            # time take() hands them to the match fn)
+            arr, target_hw = item
+            arr = jnp.asarray(arr)
+            if target_hw is not None:
+                arr = device_resize_uint8(arr, *target_hw)
+            return arr
+
         def take():
             if ahead:
                 return ahead.popleft()
-            return jnp.asarray(next_image())
+            return to_device(next_image())
 
         def pre_transfer():
             # enqueue upcoming images' host->device copies while the
             # device is busy with the current pair
             while len(ahead) < device_ahead and yielded < len(jobs):
-                ahead.append(jnp.asarray(next_image()))
+                ahead.append(to_device(next_image()))
 
         writes = collections.deque()
 
@@ -370,39 +453,64 @@ def dump_matches(
             while writes and (len(writes) > keep or writes[0].done()):
                 writes.popleft().result()
 
+        # dispatch-ahead pipeline: the device computes pair i+1 while the
+        # host reads out and postprocesses pair i (D2H + sort/dedup were
+        # ~0.5 s/pair of device idle when consumed synchronously)
+        matrices = {}  # q -> [1, n_panos, n_slots, 5] being filled
+        inflight = collections.deque()
+        pipeline_depth = 1
+
+        def consume():
+            q, idx, out, shp = inflight.popleft()
+            xa, ya, xb, yb, score = match_pair(
+                None, None, None, None, k_size, stride,
+                both_directions, flip_direction, precomputed=out,
+                shapes=shp,
+            )
+            matches = matrices[q]
+            n = min(len(xa), n_slots)
+            matches[0, idx, :n, 0] = xa[:n]
+            matches[0, idx, :n, 1] = ya[:n]
+            matches[0, idx, :n, 2] = xb[:n]
+            matches[0, idx, :n, 3] = yb[:n]
+            matches[0, idx, :n, 4] = score[:n]
+            if idx + 1 == n_panos:
+                del matrices[q]
+                out_path = os.path.join(output_dir, f"{q + 1}.mat")
+                # compression is ~100 ms of host CPU per query; run it
+                # off the consume loop so the device never waits on it
+                writes.append(
+                    writer.submit(
+                        atomic_savemat,
+                        out_path,
+                        {"matches": matches, "query_fn": _to_str(db[q][0]),
+                         "pano_fn": pano_fn_all},
+                    )
+                )
+                flush_writes()
+                if verbose:
+                    print(
+                        f"query {q + 1}/{n_queries} -> {out_path}",
+                        flush=True,
+                    )
+
         top_up()
         for q in todo:
-            out_path = os.path.join(output_dir, f"{q + 1}.mat")
-            matches = np.zeros((1, n_panos, n_slots, 5))
-            query_fn = _to_str(db[q][0])
+            matrices[q] = np.zeros((1, n_panos, n_slots, 5))
             src = take()
             tgt = take()
             for idx in range(n_panos):
                 out = jitted(params, src, tgt)  # async dispatch
+                if concat:
+                    # start the result's D2H the moment compute finishes,
+                    # without blocking this thread
+                    out.copy_to_host_async()
+                inflight.append((q, idx, out, (src.shape, tgt.shape)))
                 pre_transfer()  # H2D rides along the device compute
-                xa, ya, xb, yb, score = match_pair(
-                    jitted, params, src, tgt, k_size, stride,
-                    both_directions, flip_direction, precomputed=out,
-                )
-                n = min(len(xa), n_slots)
-                matches[0, idx, :n, 0] = xa[:n]
-                matches[0, idx, :n, 1] = ya[:n]
-                matches[0, idx, :n, 2] = xb[:n]
-                matches[0, idx, :n, 3] = yb[:n]
-                matches[0, idx, :n, 4] = score[:n]
+                while len(inflight) > pipeline_depth:
+                    consume()
                 if idx + 1 < n_panos:
                     tgt = take()
-            # compression is ~100 ms of host CPU per query; run it off
-            # the consume loop so the device never waits on it
-            writes.append(
-                writer.submit(
-                    atomic_savemat,
-                    out_path,
-                    {"matches": matches, "query_fn": query_fn,
-                     "pano_fn": pano_fn_all},
-                )
-            )
-            flush_writes()
-            if verbose:
-                print(f"query {q + 1}/{n_queries} -> {out_path}", flush=True)
+        while inflight:
+            consume()
         flush_writes(keep=0)

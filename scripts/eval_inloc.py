@@ -44,11 +44,24 @@ def main():
                         "the host-fp32 path only by uint8 rounding of the "
                         "resized pixels). false = exact host-fp32 "
                         "preprocessing")
+    p.add_argument("--device_resize", type=str2bool, default=None,
+                   help="when an image must be UPSCALED to its resize "
+                        "bucket (InLoc panos: 1600x1200 -> 2400x3200), "
+                        "ship the original uint8 and bilinear-resize on "
+                        "device — ~4x less transfer for panos. Requires "
+                        "--device_preprocess; downscaled images (queries) "
+                        "keep the host resize either way. Default: on "
+                        "whenever --device_preprocess is on")
     p.add_argument("--spatial_shards", type=int, default=0,
                    help="shard the correlation pipeline over this many "
                         "devices ('spatial' mesh axis) for grids beyond "
                         "single-chip HBM; 0 = unsharded")
     args = p.parse_args()
+
+    if args.device_resize and not args.device_preprocess:
+        p.error("--device_resize requires --device_preprocess")
+    if args.device_resize is None:
+        args.device_resize = args.device_preprocess
 
     if args.checkpoint.endswith((".pth.tar", ".pth")):
         from ncnet_tpu.utils.convert_torch import convert_checkpoint
@@ -123,6 +136,7 @@ def main():
         mesh=mesh,
         softmax=args.softmax,
         device_preprocess=args.device_preprocess,
+        device_resize=args.device_resize,
     )
 
 

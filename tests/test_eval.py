@@ -276,3 +276,112 @@ def test_device_preprocess_matches_host_path(tiny, tmp_path):
     assert overlap > 0.9, (overlap, len(rows_h), len(rows_d))
     # score distributions agree in scale
     assert abs(float(np.mean(out_h[4])) - float(np.mean(out_d[4]))) < 0.05
+
+
+def test_device_resize_matches_host_resize(tmp_path):
+    """The on-device pano upscale (`device_resize`, round 5 — ships the
+    uint8 ORIGINAL and bilinear-resizes on device, ~4x less H2D) must
+    produce the same uint8 bucket image as the host resize path, up to
+    float-order rounding at rint boundaries (<=1 gray level, rare)."""
+    from PIL import Image
+
+    from ncnet_tpu.eval.inloc import (
+        device_resize_uint8,
+        load_and_preprocess,
+        quantized_resize_shape,
+    )
+
+    rng = np.random.RandomState(7)
+    p = tmp_path / "pano.png"
+    # small "pano": upscaled by the bucket rule (image_size > max side)
+    Image.fromarray(rng.randint(0, 255, (48, 64, 3), np.uint8)).save(p)
+
+    host = load_and_preprocess(str(p), 128, 1, device_normalize=True)
+    dev, target_hw = load_and_preprocess(
+        str(p), 128, 1, device_normalize=True, device_resize=True
+    )
+    assert dev.dtype == np.uint8 and dev.shape == (1, 48, 64, 3)
+    assert target_hw == quantized_resize_shape(48, 64, 128, 1)
+    resized = np.asarray(device_resize_uint8(jnp.asarray(dev), *target_hw))
+    assert resized.shape == host.shape
+    diff = np.abs(resized.astype(np.int32) - host.astype(np.int32))
+    assert diff.max() <= 1
+    assert (diff > 0).mean() < 0.01, (diff > 0).mean()
+
+    # downscale (a "query"): device_resize falls back to the host resize
+    # and the wire image IS the bucket image
+    q = tmp_path / "query.png"
+    Image.fromarray(rng.randint(0, 255, (200, 160, 3), np.uint8)).save(q)
+    host_q = load_and_preprocess(str(q), 128, 1, device_normalize=True)
+    dev_q, hw_q = load_and_preprocess(
+        str(q), 128, 1, device_normalize=True, device_resize=True
+    )
+    assert hw_q is None
+    np.testing.assert_array_equal(dev_q, host_q)
+
+
+def test_dump_matches_device_resize_requires_preprocess(tiny, tmp_path):
+    from ncnet_tpu.eval.inloc import dump_matches
+
+    with pytest.raises(ValueError, match="device_resize requires"):
+        dump_matches(
+            tiny, TINY, shortlist_path="unused", query_path="unused",
+            pano_path="unused", output_dir=str(tmp_path / "m"),
+            device_preprocess=False, device_resize=True,
+        )
+
+
+def test_dump_matches_device_resize_equivalent(tiny, tmp_path):
+    """`dump_matches(device_resize=True)` writes the same matches as the
+    plain device-preprocess path on an upscale-bound pair."""
+    from PIL import Image
+    from scipy.io import loadmat, savemat
+
+    from ncnet_tpu.eval.inloc import dump_matches
+
+    rng = np.random.RandomState(11)
+    qdir, pdir = tmp_path / "query", tmp_path / "pano"
+    qdir.mkdir()
+    pdir.mkdir()
+    # both below the 128 bucket -> both take the device-resize branch
+    Image.fromarray(rng.randint(0, 255, (60, 80, 3), np.uint8)).save(
+        qdir / "q0.png"
+    )
+    Image.fromarray(rng.randint(0, 255, (52, 72, 3), np.uint8)).save(
+        pdir / "p0.png"
+    )
+    dt = np.dtype([("queryname", object), ("topN", object)])
+    entry = np.zeros((1, 1), dt)
+    entry[0, 0] = (
+        np.array(["q0.png"], object),
+        np.array([["p0.png"]], object),
+    )
+    savemat(tmp_path / "shortlist.mat", {"ImgList": entry})
+
+    cfg = TINY.replace(relocalization_k_size=2)
+    outs = {}
+    for name, dr in (("plain", False), ("device_resize", True)):
+        out_dir = tmp_path / f"matches_{name}"
+        dump_matches(
+            tiny,
+            cfg,
+            shortlist_path=str(tmp_path / "shortlist.mat"),
+            query_path=str(qdir),
+            pano_path=str(pdir),
+            output_dir=str(out_dir),
+            image_size=128,
+            n_queries=1,
+            n_panos=1,
+            verbose=False,
+            device_preprocess=True,
+            device_resize=dr,
+        )
+        outs[name] = loadmat(out_dir / "1.mat")["matches"]
+    a, b = outs["plain"], outs["device_resize"]
+    assert a.shape == b.shape
+    # same match coordinate sets (order may differ on score ties); the
+    # <=1-gray-level resize delta can perturb scores marginally
+    rows_a = {tuple(np.round(r[:4], 6)) for r in a[0, 0] if np.any(r)}
+    rows_b = {tuple(np.round(r[:4], 6)) for r in b[0, 0] if np.any(r)}
+    overlap = len(rows_a & rows_b) / max(len(rows_a), 1)
+    assert overlap > 0.9, (overlap, len(rows_a), len(rows_b))

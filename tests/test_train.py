@@ -77,6 +77,16 @@ def test_weak_loss_uint8_batch_matches_host_normalized():
     l_dev = float(weak_loss(params, CFG, dev))
     np.testing.assert_allclose(l_dev, l_host, rtol=1e-5, atol=1e-6)
 
+    # MIXED batch (a hand-built loader): each image keyed on its OWN
+    # dtype — the already-normalized float half must not be ImageNet-
+    # normalized a second time
+    mixed = {
+        "source_image": dev["source_image"],  # uint8
+        "target_image": host["target_image"],  # float, pre-normalized
+    }
+    l_mixed = float(weak_loss(params, CFG, mixed))
+    np.testing.assert_allclose(l_mixed, l_host, rtol=1e-5, atol=1e-6)
+
 
 def test_image_pair_dataset_uint8_output():
     """uint8_output returns rounded resized pixels, dtype uint8."""
