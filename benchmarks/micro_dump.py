@@ -79,6 +79,7 @@ def main():
                          "host-resized 23 MB bucket image instead of the "
                          "5.8 MB original)")
     args = ap.parse_args()
+    device_resize = not (args.host_fp32 or args.no_device_resize)
 
     import jax
 
@@ -123,7 +124,7 @@ def main():
                 n_panos=args.panos,
                 verbose=True,
                 device_preprocess=not args.host_fp32,
-                device_resize=not (args.host_fp32 or args.no_device_resize),
+                device_resize=device_resize,
             )
 
         import builtins
@@ -156,7 +157,7 @@ def main():
             "panos_per_query": args.panos,
             "total_s": round(total, 1),
             "device_preprocess": not args.host_fp32,
-            "device_resize": not (args.host_fp32 or args.no_device_resize),
+            "device_resize": device_resize,
             "projected_356x10_h": round(
                 356 * 10 * s_per_pair / 3600.0, 2
             ) if s_per_pair else None,

@@ -90,7 +90,8 @@ def load_and_preprocess(path, image_size, k_size, grid_multiple=None,
         img.shape[0], img.shape[1], image_size, k_size, grid_multiple
     )
     if device_resize:
-        assert device_normalize, "device_resize requires device_normalize"
+        if not device_normalize:
+            raise ValueError("device_resize requires device_normalize")
         if h * w > img.shape[0] * img.shape[1]:  # upscale: ship original
             return to_uint8_image(img)[None], (h, w)
         return to_uint8_image(resize_bilinear_np(img, h, w))[None], None
