@@ -17,6 +17,25 @@ from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
 TINY = ImMatchNetConfig(ncons_kernel_sizes=(3,), ncons_channels=(1,))
 
 
+def write_shortlist(path, queries):
+    """Write an InLoc retrieval-shortlist .mat in the schema `dump_matches`
+    parses: a MATLAB struct array ``ImgList[0, q]`` with the query
+    filename at field 0 and the pano shortlist at field 1.
+
+    ``queries``: list of ``(query_name, [pano_names])``.
+    """
+    from scipy.io import savemat
+
+    dt = np.dtype([("queryname", object), ("topN", object)])
+    entries = np.zeros((1, len(queries)), dt)
+    for q, (qname, panos) in enumerate(queries):
+        entries[0, q] = (
+            np.array([qname], object),
+            np.array([[p] for p in panos], object),
+        )
+    savemat(path, {"ImgList": entries})
+
+
 def test_quantized_resize_shape_reference_formula():
     # reference formula (eval_inloc.py:84-89) on a 1600x1200 image at
     # image_size=3200, k=2: ratio 0.5 -> 3200x2400 -> quantized to 32-mult.
@@ -112,7 +131,7 @@ def test_pck_eval_pipeline(tiny):
 def test_dump_matches_contract(tiny, tmp_path):
     """End-to-end .mat dump with a synthetic shortlist: the [1,Npanos,N,5]
     contract consumed by lib_matlab (SURVEY.md §1 L6)."""
-    from scipy.io import loadmat, savemat
+    from scipy.io import loadmat
 
     from ncnet_tpu.eval.inloc import dump_matches
 
@@ -128,16 +147,8 @@ def test_dump_matches_contract(tiny, tmp_path):
             rng.randint(0, 255, (80, 60, 3), np.uint8)
         ).save(d / name)
 
-    # shortlist schema: a MATLAB struct array; ImgList[0, q] has the query
-    # filename at field 0 and the pano shortlist at field 1
-    dt = np.dtype([("queryname", object), ("topN", object)])
-    entry = np.zeros((1, 1), dt)
-    entry[0, 0] = (
-        np.array(["q0.png"], object),
-        np.array([["p0.png"], ["p1.png"]], object),
-    )
     shortlist = tmp_path / "shortlist.mat"
-    savemat(shortlist, {"ImgList": entry})
+    write_shortlist(shortlist, [("q0.png", ["p0.png", "p1.png"])])
 
     cfg = TINY.replace(relocalization_k_size=2)
     out_dir = tmp_path / "matches"
@@ -163,6 +174,71 @@ def test_dump_matches_contract(tiny, tmp_path):
     assert (np.abs(out["matches"][0, 1]).sum() > 0)
 
 
+def test_dump_matches_multi_query_pipeline(tiny, tmp_path):
+    """Three queries with distinct panos: the 1-pair-behind consume loop
+    must route every pair's matches into the right query's matrix across
+    query boundaries (pair i is consumed while pair i+1 — possibly of
+    the NEXT query — is already dispatched), and per-query .mat files
+    must land under the right names with per-query distinct content."""
+    from PIL import Image
+    from scipy.io import loadmat
+
+    from ncnet_tpu.eval.inloc import dump_matches
+
+    rng = np.random.RandomState(21)
+    qdir, pdir = tmp_path / "query", tmp_path / "pano"
+    qdir.mkdir()
+    pdir.mkdir()
+    n_q, n_p = 3, 2
+    shortlists = []
+    for q in range(n_q):
+        Image.fromarray(
+            rng.randint(0, 255, (80, 60, 3), np.uint8)
+        ).save(qdir / f"q{q}.png")
+        names = []
+        for j in range(n_p):
+            name = f"p{q}_{j}.png"
+            Image.fromarray(
+                rng.randint(0, 255, (64, 96, 3), np.uint8)
+            ).save(pdir / name)
+            names.append(name)
+        shortlists.append(names)
+    write_shortlist(
+        tmp_path / "shortlist.mat",
+        [(f"q{q}.png", shortlists[q]) for q in range(n_q)],
+    )
+
+    cfg = TINY.replace(relocalization_k_size=2)
+    out_dir = tmp_path / "matches"
+    dump_matches(
+        tiny,
+        cfg,
+        shortlist_path=str(tmp_path / "shortlist.mat"),
+        query_path=str(qdir),
+        pano_path=str(pdir),
+        output_dir=str(out_dir),
+        image_size=128,
+        n_queries=n_q,
+        n_panos=n_p,
+        verbose=False,
+        device_preprocess=True,
+        device_resize=True,
+    )
+    outs = [loadmat(out_dir / f"{q + 1}.mat") for q in range(n_q)]
+    n_slots = n_match_slots(128, 2, True)
+    for q, out in enumerate(outs):
+        assert out["matches"].shape == (1, n_p, n_slots, 5)
+        assert str(np.ravel(out["query_fn"])[0]).strip() == f"q{q}.png"
+        for j in range(n_p):
+            assert np.abs(out["matches"][0, j]).sum() > 0
+    # distinct inputs -> distinct match score patterns per query (would
+    # fail if the pipeline wrote one query's pairs into another's matrix)
+    scores = [out["matches"][0, :, :, 4].copy() for out in outs]
+    for a in range(n_q):
+        for b in range(a + 1, n_q):
+            assert not np.allclose(scores[a], scores[b]), (a, b)
+
+
 def test_dump_matches_crash_safe_resume(tiny, tmp_path, monkeypatch):
     """A crash mid-savemat must not leave a file resume would trust: the
     write goes to a temp name + atomic rename (round-4 weakness #6), and
@@ -184,13 +260,7 @@ def test_dump_matches_crash_safe_resume(tiny, tmp_path, monkeypatch):
     Image.fromarray(rng.randint(0, 255, (70, 60, 3), np.uint8)).save(
         pdir / "p0.png"
     )
-    dt = np.dtype([("queryname", object), ("topN", object)])
-    entry = np.zeros((1, 1), dt)
-    entry[0, 0] = (
-        np.array(["q0.png"], object),
-        np.array([["p0.png"]], object),
-    )
-    savemat(tmp_path / "shortlist.mat", {"ImgList": entry})
+    write_shortlist(tmp_path / "shortlist.mat", [("q0.png", ["p0.png"])])
 
     out_dir = tmp_path / "matches"
     out_dir.mkdir()
@@ -335,7 +405,7 @@ def test_dump_matches_device_resize_equivalent(tiny, tmp_path):
     """`dump_matches(device_resize=True)` writes the same matches as the
     plain device-preprocess path on an upscale-bound pair."""
     from PIL import Image
-    from scipy.io import loadmat, savemat
+    from scipy.io import loadmat
 
     from ncnet_tpu.eval.inloc import dump_matches
 
@@ -350,13 +420,7 @@ def test_dump_matches_device_resize_equivalent(tiny, tmp_path):
     Image.fromarray(rng.randint(0, 255, (52, 72, 3), np.uint8)).save(
         pdir / "p0.png"
     )
-    dt = np.dtype([("queryname", object), ("topN", object)])
-    entry = np.zeros((1, 1), dt)
-    entry[0, 0] = (
-        np.array(["q0.png"], object),
-        np.array([["p0.png"]], object),
-    )
-    savemat(tmp_path / "shortlist.mat", {"ImgList": entry})
+    write_shortlist(tmp_path / "shortlist.mat", [("q0.png", ["p0.png"])])
 
     cfg = TINY.replace(relocalization_k_size=2)
     outs = {}
