@@ -119,6 +119,66 @@ def test_inloc_match_fn_sharded_agrees_with_unsharded():
             )
 
 
+def test_dump_matches_sharded_equals_unsharded(tmp_path):
+    """`dump_matches(mesh=...)` — the whole-dump surface with the spatial
+    sharding AND the round-5 pipelined consume loop + device_resize —
+    writes the same .mat as the unsharded dump (the sharded resize rule
+    widens the grid quantization, so compare at a shape both paths
+    produce)."""
+    from PIL import Image
+    from scipy.io import loadmat
+
+    from ncnet_tpu.eval.inloc import dump_matches
+    from tests.test_eval import write_shortlist
+
+    cfg = ImMatchNetConfig(
+        ncons_kernel_sizes=(3,),
+        ncons_channels=(1,),
+        relocalization_k_size=2,
+    )
+    params = init_immatchnet(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(9)
+    qdir, pdir = tmp_path / "query", tmp_path / "pano"
+    qdir.mkdir()
+    pdir.mkdir()
+    # 128x128 at image_size 128: both quantizations (k=2 and k*shards=4)
+    # land on the same 128x128 bucket -> outputs directly comparable
+    Image.fromarray(rng.randint(0, 255, (128, 128, 3), np.uint8)).save(
+        qdir / "q0.png"
+    )
+    Image.fromarray(rng.randint(0, 255, (128, 128, 3), np.uint8)).save(
+        pdir / "p0.png"
+    )
+    write_shortlist(tmp_path / "shortlist.mat", [("q0.png", ["p0.png"])])
+
+    outs = {}
+    for name, mesh in (
+        ("unsharded", None),
+        ("sharded", make_mesh((2,), ("spatial",),
+                              devices=jax.devices()[:2])),
+    ):
+        out_dir = tmp_path / f"matches_{name}"
+        dump_matches(
+            params,
+            cfg,
+            shortlist_path=str(tmp_path / "shortlist.mat"),
+            query_path=str(qdir),
+            pano_path=str(pdir),
+            output_dir=str(out_dir),
+            image_size=128,
+            n_queries=1,
+            n_panos=1,
+            verbose=False,
+            mesh=mesh,
+            device_preprocess=True,
+            device_resize=True,
+        )
+        outs[name] = loadmat(out_dir / "1.mat")["matches"]
+    np.testing.assert_allclose(
+        outs["sharded"], outs["unsharded"], rtol=1e-4, atol=1e-5
+    )
+
+
 def test_sharded_pipeline_per_layer_impls():
     """The sharded NC stack accepts the same comma-separated per-layer
     conv4d impl lists as the unsharded one."""
