@@ -299,8 +299,10 @@ def dump_matches(
     the dispatch loop so readout+sort+dedup of pair i overlap the device
     compute of pair i+1; and `savemat` compression runs on a writer
     thread off the consume loop. Net measured steady state: 10.75 (r3)
-    -> 3.82 (r4) -> 0.61 s/pair (r5) on the tunneled host — A/B: without
-    ``device_resize`` the same pipeline is 1.54 s/pair (H2D-bound).
+    -> 3.82 (r4) -> 0.61 s/pair (r5) on the tunneled host at 2
+    panos/query, 0.338 at the real 10-pano ratio (~20 min full dump) —
+    A/B: without ``device_resize`` the same pipeline is 1.54 s/pair
+    (H2D-bound).
     """
     import concurrent.futures
 
