@@ -1,0 +1,108 @@
+"""Throughput of the batched PF-Pascal PCK eval step (SURVEY §2.1-25).
+
+The reference's eval_pf_pascal.py is hard-coded to batch_size=1
+(eval_pf_pascal.py:52-53) and runs one forward per pair on the V100;
+ours batches and jits the whole PCK step (`eval/pf_pascal.py:24-39`:
+forward -> corr_to_matches(softmax) -> bilinear point transfer -> pck).
+This micro times that step on synthetic eval-shaped batches (400x400
+images, 20 keypoint slots) at the paper NC config and projects the full
+299-pair PF-Pascal test sweep.
+
+Run: python benchmarks/micro_pck.py [--batch 16] [--steps 20]
+Prints one JSON line.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--conv4d_impl", default=None,
+                    help="default: the model config's training-tuned mix "
+                         "(forward lowerings only matter here)")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from ncnet_tpu.eval.pf_pascal import make_pck_step
+    from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+
+    kw = {}
+    if args.conv4d_impl:
+        kw["conv4d_impl"] = args.conv4d_impl
+    config = ImMatchNetConfig(
+        ncons_kernel_sizes=(5, 5, 5),
+        ncons_channels=(16, 16, 1),
+        half_precision=True,
+        **kw,
+    )
+    params = init_immatchnet(jax.random.PRNGKey(0), config)
+    step = make_pck_step(config)
+
+    rng = np.random.RandomState(0)
+    b = args.batch
+    batch = {
+        "source_image": jnp.asarray(
+            rng.rand(b, 400, 400, 3).astype(np.float32)
+        ),
+        "target_image": jnp.asarray(
+            rng.rand(b, 400, 400, 3).astype(np.float32)
+        ),
+        "source_points": jnp.asarray(
+            np.where(
+                np.arange(20) < 8,
+                rng.rand(b, 2, 20) * 380 + 10,
+                -1.0,
+            ).astype(np.float32)
+        ),
+        "target_points": jnp.asarray(
+            np.where(
+                np.arange(20) < 8,
+                rng.rand(b, 2, 20) * 380 + 10,
+                -1.0,
+            ).astype(np.float32)
+        ),
+        "source_im_size": jnp.asarray(
+            np.tile([400.0, 400.0, 3.0], (b, 1)).astype(np.float32)
+        ),
+        "target_im_size": jnp.asarray(
+            np.tile([400.0, 400.0, 3.0], (b, 1)).astype(np.float32)
+        ),
+        "L_pck": jnp.asarray(np.full((b, 1), 224.0, np.float32)),
+    }
+
+    t0 = time.perf_counter()
+    out = step(params, batch)
+    np.asarray(out)  # D2H sync: block_until_ready is a no-op on axon
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        out = step(params, batch)
+        np.asarray(out)
+    dt = (time.perf_counter() - t0) / args.steps
+    pairs_per_s = b / dt
+    print(json.dumps({
+        "metric": "pck_eval_pairs_per_sec",
+        "value": round(pairs_per_s, 2),
+        "unit": "pairs/s",
+        "batch": b,
+        "step_ms": round(dt * 1000, 1),
+        "compile_s": round(compile_s, 1),
+        "projected_299_pair_test_sweep_s": round(299 / pairs_per_s, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

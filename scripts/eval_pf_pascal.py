@@ -15,6 +15,15 @@ def main():
     p.add_argument("--eval_dataset_path", type=str, default="datasets/pf-pascal")
     p.add_argument("--batch_size", type=int, default=1)
     p.add_argument("--num_workers", type=int, default=4)
+    p.add_argument("--conv4d_impl", type=str, default="tlc",
+                   help="conv4d lowering for the eval forward (overrides "
+                        "the checkpoint's training-tuned mix, whose "
+                        "composite VJPs are irrelevant forward-only and "
+                        "whose btl4 middle layer loses at eval: measured "
+                        "at the 25x25 grid, batch 16 — training mix 25.2 "
+                        "pairs/s, cfs 35.4, 'tlc' 48.4 — "
+                        "benchmarks/micro_pck.py). Empty string keeps "
+                        "the checkpoint's impl")
     args = p.parse_args()
 
     from ncnet_tpu.data.loader import DataLoader
@@ -30,6 +39,9 @@ def main():
 
         ck = load_checkpoint(args.checkpoint)
         config, params = ck.config, ck.params
+
+    if args.conv4d_impl:
+        config = config.replace(conv4d_impl=args.conv4d_impl)
 
     dataset = PFPascalDataset(
         os.path.join(args.eval_dataset_path, "image_pairs", "test_pairs.csv"),
