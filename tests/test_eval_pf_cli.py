@@ -1,0 +1,76 @@
+"""End-to-end test of scripts/eval_pf_pascal.py on a synthetic PF-Pascal
+fixture: checkpoint load, the `--conv4d_impl` eval override (must replace
+even a composite training mix), dataset/loader wiring, and the printed
+PCK summary."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+pytest.importorskip("PIL")
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_eval_pf_pascal_cli(tmp_path):
+    from PIL import Image
+
+    import jax
+
+    from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+    from ncnet_tpu.train.checkpoint import CheckpointData, save_checkpoint
+
+    # a checkpoint carrying a composite training impl the CLI's default
+    # 'tlc' override must replace for the forward-only eval
+    cfg = ImMatchNetConfig(
+        ncons_kernel_sizes=(3,), ncons_channels=(1,), conv4d_impl="tlc//btl"
+    )
+    params = init_immatchnet(jax.random.PRNGKey(0), cfg)
+    ckpt = tmp_path / "tiny.msgpack"
+    save_checkpoint(
+        str(ckpt),
+        CheckpointData(config=cfg, params=params, opt_state=None, epoch=0),
+    )
+
+    ds = tmp_path / "pf"
+    (ds / "image_pairs").mkdir(parents=True)
+    (ds / "JPEGImages").mkdir()
+    rng = np.random.RandomState(0)
+    for i in range(2):
+        Image.fromarray(
+            rng.randint(0, 255, (64, 64, 3), np.uint8)
+        ).save(ds / "JPEGImages" / f"im{i}.png")
+    with open(ds / "image_pairs" / "test_pairs.csv", "w") as f:
+        f.write("source_image,target_image,class,XA,YA,XB,YB\n")
+        f.write(
+            "JPEGImages/im0.png,JPEGImages/im1.png,1,"
+            "10;20;30,5;15;25,12;22;32,6;16;26\n"
+        )
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [
+            sys.executable,
+            str(REPO / "scripts" / "eval_pf_pascal.py"),
+            "--checkpoint", str(ckpt),
+            "--eval_dataset_path", str(ds),
+            "--image_size", "64",
+            "--num_workers", "0",
+        ],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=str(REPO),
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "Valid: 1" in r.stdout
+    # one pair, 3 keypoints: PCK is k/3 for some k in 0..3
+    line = [ln for ln in r.stdout.splitlines() if ln.startswith("PCK:")]
+    assert line, r.stdout
+    pck = float(line[0].split()[1].rstrip("%")) / 100.0
+    assert any(np.isclose(pck, k / 3.0, atol=5e-3) for k in range(4)), pck
