@@ -178,17 +178,29 @@ def main():
     # chain; more steps amortize that measurement constant (it is not part
     # of the training step itself)
     p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--sanitize", action="store_true",
+                   help="enable per-stage finiteness/bf16 probes "
+                        "(ncnet_tpu.analysis.sanitizer); on a non-finite "
+                        "loss the bench stops with the per-stage report "
+                        "and the first non-finite stage instead of a bare "
+                        "assert. The probes add work — a --sanitize run "
+                        "is a diagnostic, NOT a throughput number (the "
+                        "JSON is tagged \"sanitized\")")
     args = p.parse_args()
 
     import jax
     import jax.numpy as jnp
 
+    from ncnet_tpu.analysis import sanitizer
     from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
     from ncnet_tpu.train.step import (
         create_train_state,
         make_optimizer,
         make_train_step,
     )
+
+    if args.sanitize:  # before any tracing: taps are bound at trace time
+        sanitizer.enable()
 
     preset = CONFIGS[args.config]
     impl = args.conv4d_impl if args.conv4d_impl is not None else preset["impl"]
@@ -222,12 +234,24 @@ def main():
         ),
     }
 
+    def check_finite(loss_host, context):
+        # the finite-loss gate exists so a numerically broken config can
+        # never report a throughput; sanitized runs upgrade the bare
+        # failure to a per-stage report naming the first non-finite stage
+        if args.sanitize:
+            sanitizer.check_finite_or_report(loss_host, context=context)
+        else:
+            assert np.isfinite(loss_host), (
+                f"non-finite loss {loss_host} at {context} "
+                "(re-run with --sanitize to localize the first "
+                "non-finite stage)"
+            )
+
     # Compile + warmup with a per-step D2H sync (the ONLY reliable way to
     # force execution here; block_until_ready is a no-op on this platform).
-    for _ in range(2):
+    for w in range(2):
         state, loss = step(state, batch)
-        loss_host = float(loss)
-        assert np.isfinite(loss_host), f"non-finite loss {loss_host}"
+        check_finite(float(loss), f"warmup step {w}")
 
     # Timed: steps chain through the state dependency, so ONE final D2H
     # forces the whole sequence; the ~80 ms roundtrip latency of this
@@ -238,7 +262,9 @@ def main():
         state, loss = step(state, batch)
     loss_host = float(loss)
     dt = time.perf_counter() - t0
-    assert np.isfinite(loss_host), f"non-finite loss {loss_host}"
+    check_finite(loss_host, f"timed chain ({n_steps} steps)")
+    if args.sanitize:
+        print(sanitizer.report_text(), flush=True)
 
     pairs_per_sec = batch_size * n_steps / dt
     step_flops = train_step_flops(
@@ -259,6 +285,7 @@ def main():
                 "step_ms": round(dt / n_steps * 1e3, 1),
                 "analytic_tflop_per_step": round(step_flops / 1e12, 2),
                 "mfu_vs_v5e_bf16_peak": round(mfu, 4),
+                **({"sanitized": True} if args.sanitize else {}),
             }
         )
     )

@@ -74,7 +74,7 @@ def main():
                 return dw.astype(jnp.float32)
 
         else:
-            assert form in DW_IMPLS, form
+            assert form in DW_IMPLS, form  # nclint: disable=bare-assert -- bench-internal invariant over its own sweep table; measurement scripts never run under -O
 
             def dw_fn(x, gg, w, form=form):
                 return _dw_direct(form, x, gg, w.shape).astype(jnp.float32)

@@ -70,7 +70,7 @@ def main():
                 return dx
 
         else:
-            assert kind == "explicit", form
+            assert kind == "explicit", form  # nclint: disable=bare-assert -- bench-internal invariant over its own sweep table; measurement scripts never run under -O
 
             def dx_fn(gg, w, impl=impl):
                 return conv4d(

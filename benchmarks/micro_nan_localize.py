@@ -1,0 +1,131 @@
+"""Localize the first non-finite stage of the 'Not shipped' NaN config.
+
+PERF.md records a config that was stepped around, not understood:
+sym-sequential + single-chunk-16 + no-remat measured 18.6 pairs/s over 4
+steps but NaN'd the bench's 30-step random-init training on iid-noise
+inputs (loss wanders 0 -> 0.06 -> NaN while the chunk-8 trajectory stays
+at +-3e-5 — identical math, different float order). The bench's finite-
+loss assertion caught it but said nothing about WHERE.
+
+This harness reproduces that config's TOPOLOGY (symmetric_batch=False,
+loss_chunk == batch with loss_chunk_remat=False — which weak_loss runs as
+the plain unchunked no-remat path, exactly what `bench.py --sym_seq
+--loss_chunk 16` compiles at batch 16 — bf16, the shipped per-layer impl
+mix, random init, one fixed iid-noise batch) with the numerical sanitizer
+enabled, so the run ends with a per-stage finiteness table and the name
+of the first non-finite stage in dataflow order instead of a bare assert.
+
+Scale knobs (--image/--batch) exist because the original shape (400x400,
+batch 16) is TPU-sized; on the CPU test platform run e.g.
+
+    python benchmarks/micro_nan_localize.py --image 128 --batch 8 \
+        --steps 120 --lr 5e-4
+
+and escalate --lr when the divergence needs a push at small scale (the
+bf16-ordering-noise amplifier is weaker at 8^4 correlation cells than at
+25^4; record the lr used). Prints one JSON line with the outcome.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--image", type=int, default=128)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--steps", type=int, default=120)
+    p.add_argument("--lr", type=float, default=5e-4)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--log_every", type=int, default=5)
+    p.add_argument("--conv4d_impl", default="tlc//btl,btl4,tlc/tlc/tf3",
+                   help="the shipped PF-Pascal per-layer mix (PERF.md)")
+    p.add_argument("--chunk8_control", action="store_true",
+                   help="run the SHIPPED chunk-8 + symmetric-batch config "
+                        "instead (the trajectory that stays finite) as an "
+                        "A/B control at the same scale")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from ncnet_tpu.analysis import sanitizer
+    from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+    from ncnet_tpu.train.step import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    sanitizer.enable()
+
+    if args.chunk8_control:
+        chunk, sym_batch = min(8, args.batch // 2 or 1), True
+    else:
+        # the NaN config: a single chunk covering the batch, no remat,
+        # sequential symmetric passes
+        chunk, sym_batch = args.batch, False
+    config = ImMatchNetConfig(
+        ncons_kernel_sizes=(5, 5, 5),
+        ncons_channels=(16, 16, 1),
+        half_precision=True,
+        conv4d_impl=args.conv4d_impl,
+        nc_remat=False,
+        loss_chunk=chunk,
+        loss_chunk_remat=False,
+        symmetric_batch=sym_batch,
+    )
+    params = init_immatchnet(jax.random.PRNGKey(args.seed), config)
+    optimizer = make_optimizer(args.lr)
+    state = create_train_state(params, optimizer)
+    step = make_train_step(config, optimizer, donate=False)
+
+    rng = np.random.RandomState(args.seed)
+    batch = {
+        "source_image": jnp.asarray(
+            rng.randn(args.batch, args.image, args.image, 3).astype(np.float32)
+        ),
+        "target_image": jnp.asarray(
+            rng.randn(args.batch, args.image, args.image, 3).astype(np.float32)
+        ),
+    }
+
+    t0 = time.time()
+    outcome = {"nan_step": None, "first_nonfinite": None,
+               "losses_head": [], "loss_last": None}
+    for i in range(args.steps):
+        state, loss = step(state, batch)
+        loss_host = float(loss)
+        if i < 10 or (i + 1) % args.log_every == 0:
+            print(f"step {i + 1}: loss {loss_host:.6g} "
+                  f"({time.time() - t0:.0f}s)", flush=True)
+        if len(outcome["losses_head"]) < 10:
+            outcome["losses_head"].append(loss_host)
+        outcome["loss_last"] = loss_host
+        if not np.isfinite(loss_host):
+            outcome["nan_step"] = i + 1
+            fnf = sanitizer.first_nonfinite()
+            outcome["first_nonfinite"] = (
+                {"stage": fnf[0], **fnf[1]} if fnf else None
+            )
+            break
+
+    print(sanitizer.report_text(), flush=True)
+    outcome["stage_summary"] = sanitizer.summary()
+    outcome["config"] = {
+        "image": args.image, "batch": args.batch, "lr": args.lr,
+        "loss_chunk": chunk, "symmetric_batch": sym_batch,
+        "impl": args.conv4d_impl, "steps_run": min(args.steps, i + 1),
+    }
+    print(json.dumps(outcome))
+
+
+if __name__ == "__main__":
+    main()

@@ -81,7 +81,7 @@ def main():
 
     for combo in args.combos:
         impls = combo.split(",")
-        assert len(impls) == len(channels), combo
+        assert len(impls) == len(channels), combo  # nclint: disable=bare-assert -- bench-internal check of the user-typed --combos string; measurement scripts never run under -O
 
         def make_fwd_chain(n, impls=impls):
             @jax.jit

@@ -20,14 +20,15 @@ Design notes (TPU-first, not a port):
     correlation tensor (the long-context analog) with halo exchange.
 """
 
-from ncnet_tpu import data, models, ops, parallel, train, utils
+from ncnet_tpu import analysis, data, models, ops, parallel, train, utils
 from ncnet_tpu.models.immatchnet import ImMatchNet, ImMatchNetConfig
 
-__version__ = "0.1.0"
+__version__ = "0.1.0"  # keep in sync with pyproject.toml
 
 __all__ = [
     "ImMatchNet",
     "ImMatchNetConfig",
+    "analysis",
     "data",
     "models",
     "ops",

@@ -1,0 +1,22 @@
+"""Static analysis + runtime numerical sanitizers for the framework.
+
+Two complementary halves (rule catalog and usage: README.md next to this
+file):
+
+  * `engine` / `rules` / `cli` — an AST lint suite encoding the JAX/TPU
+    hazards this project has been bitten by (bare contract asserts, host
+    syncs inside compiled regions, eps-less divisions, unstable exp,
+    Python branches on traced values, mutable defaults). CI gate:
+    ``python scripts/lint.py ncnet_tpu scripts benchmarks``.
+  * `sanitizer` — per-stage finiteness / bf16-range probes behind
+    ``--sanitize`` on scripts/train.py and bench.py; localizes a NaN to
+    the first non-finite stage instead of a dead training run.
+
+The subpackage is import-light on purpose: `sanitizer` is imported by the
+model/training modules at instrumentation points, so it must not drag the
+lint machinery (or anything heavier than jax itself) along.
+"""
+
+from ncnet_tpu.analysis import sanitizer
+
+__all__ = ["sanitizer"]

@@ -1,0 +1,66 @@
+"""`nclint` — run the JAX-aware static lint suite over source trees.
+
+Exit status is 0 only when no unsuppressed finding at or above
+``--fail-on`` severity remains — the CI gate is simply
+
+    python scripts/lint.py ncnet_tpu scripts benchmarks
+
+(or ``nclint ...`` once the package is pip-installed; see pyproject.toml's
+``[project.scripts]``).
+"""
+
+import argparse
+import sys
+
+from ncnet_tpu.analysis import rules  # noqa: F401  (registers the rule set)
+from ncnet_tpu.analysis.engine import (
+    RULES,
+    SEVERITY_ORDER,
+    format_json,
+    format_text,
+    lint_paths,
+)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="nclint",
+        description="JAX/TPU-aware static lint (rule catalog: "
+                    "ncnet_tpu/analysis/README.md)",
+    )
+    p.add_argument("paths", nargs="*", default=["."],
+                   help="files or directories to lint (default: .)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable JSON output")
+    p.add_argument("--fail-on", choices=sorted(SEVERITY_ORDER),
+                   default="warning",
+                   help="lowest severity that fails the run (default: "
+                        "warning). Findings below it are still printed.")
+    p.add_argument("--select", default="",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for r in sorted(RULES.values(), key=lambda r: r.rule_id):
+            print(f"{r.rule_id} ({r.severity}): {' '.join(r.doc.split())}")
+        return 0
+
+    selected = None
+    if args.select:
+        selected = [s.strip() for s in args.select.split(",") if s.strip()]
+        unknown = [s for s in selected if s not in RULES]
+        if unknown:
+            p.error(f"unknown rule id(s): {', '.join(unknown)} "
+                    f"(see --list-rules)")
+
+    findings = lint_paths(args.paths or ["."], selected)
+    print(format_json(findings) if args.json else format_text(findings))
+    threshold = SEVERITY_ORDER[args.fail_on]
+    gating = [f for f in findings if SEVERITY_ORDER[f.severity] >= threshold]
+    return 1 if gating else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,235 @@
+"""AST lint engine: file walker, rule registry, findings, suppressions.
+
+The engine is deliberately small and dependency-free (stdlib ``ast`` only):
+rules are plain functions registered with the `rule` decorator, each
+receiving a parsed module plus a `ModuleContext` with resolved import
+aliases, and yielding ``(node, message)`` pairs. The JAX/TPU-specific rule
+set lives in `ncnet_tpu.analysis.rules`; importing it populates the
+registry as a side effect.
+
+Suppression contract (enforced, not advisory): a finding is silenced only
+by an inline directive ON THE FLAGGED LINE of the form
+
+    # nclint: disable=<rule-id>[,<rule-id>...] -- <reason>
+
+and the reason is MANDATORY — a directive without one is itself reported
+as a `bad-suppression` error, so every silenced finding carries a written
+justification next to the code it excuses.
+"""
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+
+SEVERITY_ORDER = {"info": 0, "warning": 1, "error": 2}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*nclint:\s*disable=([A-Za-z0-9_,\-]+)\s*(?:--\s*(\S.*))?"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding, addressable as ``path:line:col``."""
+
+    path: str
+    line: int
+    col: int
+    rule: str
+    severity: str
+    message: str
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.severity} [{self.rule}] {self.message}"
+        )
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class ModuleContext:
+    """Per-module facts shared by rules: import aliases + test-ness.
+
+    ``canonical(node)`` resolves an ``ast.Name``/``ast.Attribute`` chain to
+    its canonical dotted path through the module's imports, so rules match
+    ``jax.numpy.max`` whether the source spells it ``jnp.max``,
+    ``jax.numpy.max`` or ``from jax import numpy; numpy.max``.
+    """
+
+    def __init__(self, tree: ast.Module, path: str, source: str):
+        self.tree = tree
+        self.path = path
+        self.source = source
+        base = os.path.basename(path)
+        parts = os.path.normpath(path).split(os.sep)
+        self.is_test = (
+            base.startswith("test_")
+            or base == "conftest.py"
+            or "tests" in parts
+        )
+        self.aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.level:  # relative imports: not external libraries
+                    continue
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = (
+                        f"{node.module}.{a.name}"
+                    )
+
+    def canonical(self, node: ast.AST) -> Optional[str]:
+        """Dotted canonical name for a Name/Attribute chain, else None."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.aliases.get(node.id, node.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+
+RuleFn = Callable[[ModuleContext], Iterator[Tuple[ast.AST, str]]]
+
+RULES: Dict[str, "Rule"] = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    rule_id: str
+    severity: str
+    doc: str
+    fn: RuleFn
+
+
+def rule(rule_id: str, severity: str = "warning", doc: str = ""):
+    """Register a rule function; ``fn(ctx)`` yields ``(node, message)``."""
+    if severity not in SEVERITY_ORDER:
+        raise ValueError(f"unknown severity {severity!r}")
+
+    def wrap(fn: RuleFn) -> RuleFn:
+        if rule_id in RULES:
+            raise ValueError(f"duplicate rule id {rule_id!r}")
+        RULES[rule_id] = Rule(rule_id, severity, doc or (fn.__doc__ or ""), fn)
+        return fn
+
+    return wrap
+
+
+def _parse_suppressions(source: str, path: str):
+    """Per-line suppression sets + findings for malformed directives."""
+    suppressed: Dict[int, set] = {}
+    bad: List[Finding] = []
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        reason = (m.group(2) or "").strip()
+        if not reason:
+            # the directive still suppresses (so the ONE actionable error
+            # is the missing reason, not a duplicate of the silenced
+            # finding) but fails the gate until a reason is written
+            bad.append(
+                Finding(
+                    path, lineno, line.index("#"), "bad-suppression", "error",
+                    "suppression without a reason: use "
+                    "'# nclint: disable=<rule> -- <why this is safe>'",
+                )
+            )
+        suppressed[lineno] = suppressed.get(lineno, set()) | rules
+    return suppressed, bad
+
+
+def lint_source(
+    source: str, path: str, rules: Optional[Iterable[str]] = None
+) -> List[Finding]:
+    """Lint one module's source text; returns unsuppressed findings."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                path, e.lineno or 1, e.offset or 0, "syntax-error", "error",
+                f"cannot parse: {e.msg}",
+            )
+        ]
+    ctx = ModuleContext(tree, path, source)
+    suppressed, findings = _parse_suppressions(source, path)
+    selected = (
+        RULES.values() if rules is None
+        else [RULES[r] for r in rules]
+    )
+    for r in selected:
+        for node, message in r.fn(ctx):
+            line = getattr(node, "lineno", 1)
+            col = getattr(node, "col_offset", 0)
+            if r.rule_id in suppressed.get(line, ()):
+                continue
+            findings.append(
+                Finding(path, line, col, r.rule_id, r.severity, message)
+            )
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_file(path: str, rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path, rules)
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    """Expand files/directories into sorted .py paths (dirs recursively)."""
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs
+                if not d.startswith(".") and d != "__pycache__"
+            )
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def lint_paths(
+    paths: Iterable[str], rules: Optional[Iterable[str]] = None
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(lint_file(path, rules))
+    return findings
+
+
+def max_severity(findings: Iterable[Finding]) -> int:
+    return max(
+        (SEVERITY_ORDER[f.severity] for f in findings), default=-1
+    )
+
+
+def format_text(findings: List[Finding]) -> str:
+    lines = [f.format() for f in findings]
+    lines.append(
+        f"{len(findings)} finding(s)" if findings else "clean: 0 findings"
+    )
+    return "\n".join(lines)
+
+
+def format_json(findings: List[Finding]) -> str:
+    return json.dumps(
+        {"findings": [f.to_dict() for f in findings], "count": len(findings)},
+        indent=2,
+    )

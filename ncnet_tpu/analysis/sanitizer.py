@@ -1,0 +1,235 @@
+"""Runtime numerical sanitizer: per-stage finiteness + bf16-range probes.
+
+The static rules catch *mechanically detectable* hazards; this module
+catches the ones that only exist at runtime — the class of failure PERF.md
+records as "stepped around, not understood": a config whose loss wanders
+and then NaNs with no indication of WHERE the first non-finite value was
+born. `tap(name, x)` instruments a stage boundary; when the sanitizer is
+enabled every tap emits a `jax.debug.callback` that records, per stage and
+per step, the finite fraction, the max finite |x|, and whether the value
+range exceeds what bfloat16 can represent. `first_nonfinite()` then names
+the earliest stage (in dataflow/trace order) that ever produced a
+non-finite value — turning "it NaN'd" into "stage X went non-finite first".
+
+Zero-cost when disabled: `tap` checks the enable flag at TRACE time and
+returns its argument untouched, so the instrumented model compiles to
+exactly the same XLA program unless `--sanitize` was passed. Consequences:
+
+  * enable() must run BEFORE the instrumented function is first traced
+    (a jit cache hit bypasses tracing; the CLI flags do this correctly);
+  * under rematerialization the backward pass re-runs the forward, so each
+    remat'd stage reports twice per step — harmless for finiteness;
+  * callbacks are unordered across stages; dataflow ordering comes from
+    the trace-order index recorded when each tap first traces, not from
+    callback arrival time.
+
+The probes are cheap (two reductions per tap) but they do add device work
+and host callbacks: ~10-30% step overhead at synthetic-config scale, fine
+for debugging runs, not for production training.
+
+Coverage under lax.map (measured, jax 0.4.37): `jax.debug.callback` fires
+inside a `lax.map`/`scan` body under jit, and in eager/forward-only runs —
+but when the map is DIFFERENTIATED, callbacks staged in the primal pass
+are dropped (ordered=True and custom_vjp identities do not help; the
+effects only re-fire when a `jax.checkpoint`-remat'd backward re-runs the
+body). Consequence for the chunked training loss: on the no-remat chunk
+path the per-stage probes inside each chunk go silent under grad, and with
+`loss_chunk_remat=True` they report via the backward recompute instead.
+The chunk OUTPUTS (`score_pos_chunks`/`score_neg_chunks`, tapped outside
+the map in train/loss.py), the loss, and every grad/update leaf always
+report. The unchunked paths — including the PERF.md "Not shipped" NaN
+config, which runs chunk == batch == unchunked — have full per-stage
+coverage.
+"""
+
+import functools
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+#: largest finite bfloat16 (same exponent range as f32; an overflow here
+#: means the value is inf in BOTH dtypes — the probe mainly catches
+#: exp/product blowups on their way up)
+BF16_MAX = 3.3895313892515355e38
+
+_lock = threading.Lock()
+_enabled = False
+_reports = []  # dicts appended by the debug callbacks, host side
+_stage_order = []  # stage names in first-trace order (= dataflow order)
+_verbose_nonfinite = True
+
+
+def enable(on=True):
+    """Turn the sanitizer on/off. Must be called before the instrumented
+    functions are first traced (see module docstring)."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def is_enabled():
+    return _enabled
+
+
+def clear(stage_order=False):
+    """Drop recorded reports (e.g. between runs or test cases).
+
+    The stage ORDER is kept by default: it is trace-time metadata, and an
+    already-compiled function will not re-trace to rebuild it — clearing
+    it between runs of the same jitted step would break `first_nonfinite`
+    dataflow ordering. Pass ``stage_order=True`` only when the next run
+    re-traces from scratch (e.g. a fresh test case with new functions).
+    """
+    with _lock:
+        _reports.clear()
+        if stage_order:
+            _stage_order.clear()
+
+
+def reports():
+    """All per-stage records so far: list of dicts with ``stage``,
+    ``finite_frac``, ``absmax``, ``bf16_overflow``."""
+    with _lock:
+        return list(_reports)
+
+
+def _record(stage, finite_frac, absmax):
+    rec = {
+        "stage": stage,
+        "finite_frac": float(finite_frac),
+        "absmax": float(absmax),
+        "bf16_overflow": bool(float(absmax) > BF16_MAX),
+    }
+    with _lock:
+        _reports.append(rec)
+    if rec["finite_frac"] < 1.0 and _verbose_nonfinite:
+        print(
+            f"[sanitize] NON-FINITE at stage '{stage}': "
+            f"finite_frac={rec['finite_frac']:.6f} "
+            f"absmax(finite)={rec['absmax']:.3e}",
+            flush=True,
+        )
+
+
+def tap(stage, x):
+    """Probe one array at a named stage boundary; returns ``x`` unchanged.
+
+    No-op (identity, zero trace residue) when the sanitizer is disabled.
+    Non-floating inputs (ints, bools) pass through unprobed — finiteness
+    is vacuous for them.
+    """
+    if not _enabled:
+        return x
+    if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
+        return x
+    with _lock:
+        if stage not in _stage_order:
+            _stage_order.append(stage)
+    xf = jnp.asarray(x).astype(jnp.float32)
+    finite = jnp.isfinite(xf)
+    finite_frac = jnp.mean(finite.astype(jnp.float32))
+    absmax = jnp.max(jnp.where(finite, jnp.abs(xf), 0.0))
+    jax.debug.callback(
+        functools.partial(_record, stage), finite_frac, absmax
+    )
+    return x
+
+
+# the single-array probe is also the right shape for scan/vmap carries;
+# export the name the harness docs use
+tap_finite = tap
+
+
+def sanitize_pytree(stage, tree):
+    """`tap` every floating leaf of a pytree, naming leaves by key path.
+
+    Returns the tree unchanged (identity when disabled).
+    """
+    if not _enabled:
+        return tree
+
+    def probe(path, leaf):
+        name = f"{stage}{jax.tree_util.keystr(path)}"
+        return tap(name, leaf)
+
+    return jax.tree_util.tree_map_with_path(probe, tree)
+
+
+def first_nonfinite():
+    """Name of the earliest stage (dataflow order) that ever went
+    non-finite, or None. The per-stage record of its FIRST non-finite
+    observation rides along as the second tuple element."""
+    with _lock:
+        bad = {}
+        for rec in _reports:
+            if rec["finite_frac"] < 1.0 and rec["stage"] not in bad:
+                bad[rec["stage"]] = rec
+        for stage in _stage_order:
+            if stage in bad:
+                return stage, bad[stage]
+        # non-finite at a stage we never saw trace (shouldn't happen)
+        for stage, rec in bad.items():
+            return stage, rec
+    return None
+
+
+def summary():
+    """Per-stage aggregate in dataflow order: observation count, non-finite
+    count, running max |x|, and whether bf16 range was ever exceeded."""
+    with _lock:
+        agg = {}
+        for rec in _reports:
+            s = agg.setdefault(
+                rec["stage"],
+                {"stage": rec["stage"], "observations": 0, "nonfinite": 0,
+                 "absmax": 0.0, "bf16_overflow": False},
+            )
+            s["observations"] += 1
+            s["nonfinite"] += rec["finite_frac"] < 1.0
+            s["absmax"] = max(s["absmax"], rec["absmax"])
+            s["bf16_overflow"] |= rec["bf16_overflow"]
+        order = [s for s in _stage_order if s in agg]
+        order += [s for s in agg if s not in order]
+        return [agg[s] for s in order]
+
+
+def report_text():
+    """Human-readable per-stage table (dataflow order)."""
+    rows = summary()
+    if not rows:
+        return "[sanitize] no observations (sanitizer disabled or no taps ran)"
+    w = max(len(r["stage"]) for r in rows)
+    lines = [
+        f"[sanitize] {'stage'.ljust(w)}  obs  nonfinite  absmax      bf16_ovf"
+    ]
+    for r in rows:
+        lines.append(
+            f"[sanitize] {r['stage'].ljust(w)}  "
+            f"{r['observations']:>3}  {r['nonfinite']:>9}  "
+            f"{r['absmax']:<10.3e}  {'YES' if r['bf16_overflow'] else 'no'}"
+        )
+    fnf = first_nonfinite()
+    if fnf:
+        lines.append(
+            f"[sanitize] first non-finite stage (dataflow order): {fnf[0]}"
+        )
+    else:
+        lines.append("[sanitize] all observed stages finite")
+    return "\n".join(lines)
+
+
+def check_finite_or_report(loss_value, context=""):
+    """Host-side guard for training loops: if ``loss_value`` is non-finite,
+    print the per-stage report and raise FloatingPointError naming the
+    first non-finite stage."""
+    if np.isfinite(loss_value):
+        return
+    print(report_text(), flush=True)
+    fnf = first_nonfinite()
+    where = f"; first non-finite stage: {fnf[0]}" if fnf else ""
+    raise FloatingPointError(
+        f"non-finite loss {loss_value}{' at ' + context if context else ''}"
+        f"{where}"
+    )

@@ -84,6 +84,24 @@ def load_and_preprocess(path, image_size, k_size, grid_multiple=None,
     the dominant per-pair host->device transfer from ~23 MB to ~5.8 MB.
     Downscales keep the host resize (the resized image is the smaller
     wire format there) and return ``(resized uint8, None)``.
+
+    Two scope notes on the device_resize path (ADVICE r5):
+
+    * Compile cost scales with DISTINCT ORIGINAL shapes: upscaled
+      originals ship at their raw, unquantized size, so each new
+      original shape jit-compiles `device_resize_uint8` once — the
+      resize-quantization bucketing only caps the RESIZED shapes. Free
+      on real InLoc (panos are uniformly 1600x1200 -> one compile), but
+      a dataset of heterogeneous originals would thrash the jit cache;
+      pad such originals to a few buckets first, or keep
+      ``device_resize=False`` there.
+    * The upscale test is total-AREA based (``h*w`` grows), which
+      assumes the aspect-preserving resize rule: both axes then scale by
+      the same factor and area growth implies per-axis growth. A caller
+      feeding shapes that upscale one axis while downscaling the other
+      (impossible under `quantized_resize_shape`) would ship an original
+      larger than needed on the downscaled axis — compare per-axis
+      before reusing this helper outside the InLoc resize rule.
     """
     img = load_image(path)
     h, w = quantized_resize_shape(
@@ -221,8 +239,14 @@ def match_pair(match_fn, params, src, tgt, k_size, stride=16,
             parts = np.asarray(fwd)
     else:
         # a `concat_directions` match fn (live or precomputed): already
-        # the combined [5, b, n] array
-        assert both_directions, "combined output implies both_directions"
+        # the combined [5, b, n] array. A contract check, not an assert:
+        # under python -O an assert would silently treat the [5, b, n]
+        # concat as a single-direction result (ADVICE r5).
+        if not both_directions:
+            raise ValueError(
+                "combined [5, b, n] match output implies both_directions; "
+                "pass both_directions=True or use a non-concat match fn"
+            )
         parts = np.asarray(out)
     xa, ya, xb, yb, score = parts[:, 0]
 
@@ -314,7 +338,14 @@ def dump_matches(
             "format + on-device ImageNet normalization)"
         )
     k_size = config.relocalization_k_size
-    assert backbone_stride(config.feature_extraction_cnn) == int(1 / SCALE_FACTOR)
+    stride_actual = backbone_stride(config.feature_extraction_cnn)
+    if stride_actual != int(1 / SCALE_FACTOR):
+        raise ValueError(
+            f"backbone stride {stride_actual} does not match the dump's "
+            f"SCALE_FACTOR {SCALE_FACTOR} (expects stride "
+            f"{int(1 / SCALE_FACTOR)}); the .mat coordinate contract "
+            "assumes the reference's 1/16 feature stride"
+        )
     grid_multiple = None
     if mesh is not None:
         grid_multiple = max(k_size, 1) * mesh.shape["spatial"]

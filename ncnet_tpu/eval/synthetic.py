@@ -53,7 +53,7 @@ def make_synthetic_pck_step(config, alpha=0.1, n_side=4):
 
         # ground truth: x_src = x_tgt - shift (never wraps for these points)
         gt = tgt_px.at[:, 0, :].add(-batch["shift"][:, None])
-        l_pck = jnp.full((b, 1), float(w), jnp.float32)
+        l_pck = jnp.full((b, 1), w, jnp.float32)
         return pck(gt, warped_px, l_pck, alpha=alpha)
 
     return jax.jit(step)

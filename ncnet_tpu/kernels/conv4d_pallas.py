@@ -137,7 +137,11 @@ def w2_kernel_dims(w2, cin, cout):
     weight matrix, assuming a hypercubic kernel."""
     taps = w2.shape[0] // cin
     k = round(taps ** 0.25)
-    assert k**4 * cin == w2.shape[0] and w2.shape[1] == cout
+    if k**4 * cin != w2.shape[0] or w2.shape[1] != cout:
+        raise ValueError(
+            f"flattened weight {w2.shape} is not a hypercubic "
+            f"[k^4*cin, cout] matrix for cin={cin}, cout={cout}"
+        )
     return k, k, k, k
 
 

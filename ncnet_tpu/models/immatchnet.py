@@ -20,6 +20,7 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from ncnet_tpu.analysis import sanitizer
 from ncnet_tpu.models.feature_extraction import (
     backbone_channels,
     backbone_stride,
@@ -116,7 +117,11 @@ def match_pipeline(nc_params, config: ImMatchNetConfig, feat_a, feat_b):
     else:
         corr = correlation_4d(feat_a, feat_b)
 
-    corr = mutual_matching(corr)
+    # sanitizer taps are identity unless --sanitize enabled them before
+    # the first trace (analysis/sanitizer.py): per-stage finiteness +
+    # bf16-range probes at every pipeline boundary
+    corr = sanitizer.tap("correlation", corr)
+    corr = sanitizer.tap("mutual_matching_pre", mutual_matching(corr))
     corr = neigh_consensus_apply(
         nc_params,
         corr.astype(dtype) if dtype else corr,
@@ -125,7 +130,10 @@ def match_pipeline(nc_params, config: ImMatchNetConfig, feat_a, feat_b):
         remat=config.nc_remat,
         symmetric_batch=config.symmetric_batch,
     )
-    corr = mutual_matching(corr).astype(jnp.float32)
+    corr = sanitizer.tap("neigh_consensus", corr)
+    corr = sanitizer.tap(
+        "mutual_matching_post", mutual_matching(corr).astype(jnp.float32)
+    )
     if k > 1:
         return corr, delta4d
     return corr
@@ -133,13 +141,16 @@ def match_pipeline(nc_params, config: ImMatchNetConfig, feat_a, feat_b):
 
 def extract_features(params, config: ImMatchNetConfig, image):
     dtype = jnp.bfloat16 if config.half_precision else None
-    return feature_extraction_apply(
-        params["feature_extraction"],
-        image,
-        cnn=config.feature_extraction_cnn,
-        normalize=config.normalize_features,
-        dtype=dtype,
-        center=config.center_features,
+    return sanitizer.tap(
+        "features",
+        feature_extraction_apply(
+            params["feature_extraction"],
+            image,
+            cnn=config.feature_extraction_cnn,
+            normalize=config.normalize_features,
+            dtype=dtype,
+            center=config.center_features,
+        ),
     )
 
 

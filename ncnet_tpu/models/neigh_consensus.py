@@ -11,6 +11,7 @@ import jax
 import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
+from ncnet_tpu.analysis import sanitizer
 from ncnet_tpu.ops.conv4d import conv4d_packed, resolve_layer_impls
 
 
@@ -31,7 +32,11 @@ def init_neigh_consensus(rng, kernel_sizes=(3, 3, 3), channels=(10, 10, 1),
     0.73 -> 0.98 in 400 steps. Used by the synthetic proofs
     (scripts/synthetic_convergence.py, scripts/synthetic_inloc_e2e.py).
     """
-    assert len(kernel_sizes) == len(channels)
+    if len(kernel_sizes) != len(channels):
+        raise ValueError(
+            f"kernel_sizes {tuple(kernel_sizes)} and channels "
+            f"{tuple(channels)} must have one entry per NC layer"
+        )
     params = []
     cin = 1
     keys = jax.random.split(rng, len(channels))
@@ -142,8 +147,12 @@ def neigh_consensus_apply(params, corr, symmetric=True, impl="xla", remat=False,
     def net(x):
         kl = (x.shape[3], x.shape[4])
         xp = _pack(x)
-        for p, layer_impl in zip(params, layer_impls):
+        for li, (p, layer_impl) in enumerate(zip(params, layer_impls)):
             xp = layer_fn(xp, p, kl, layer_impl)
+            # identity unless --sanitize: per-NC-layer finiteness probe
+            # (under remat each layer reports twice per step — fwd + the
+            # backward recompute — harmless for finiteness)
+            xp = sanitizer.tap(f"nc_layer{li}", xp)
         return _unpack(xp, *kl)
 
     x = corr[..., None]

@@ -309,6 +309,19 @@ def _conv4d_scan(x, w):
     return jnp.moveaxis(out, 0, 1)
 
 
+def _check_packed(kl_shape, cin, fused):
+    """The packed-layout contract: trailing dim is exactly k*l*cin. A
+    mismatch means the caller's kl_shape/weights disagree with the packed
+    activation — raise (not assert: must survive python -O)."""
+    k, l = kl_shape
+    if k * l * cin != fused:
+        raise ValueError(
+            f"packed trailing dim {fused} != k*l*cin = "
+            f"{k}*{l}*{cin} (kl_shape {kl_shape}); the [b, i, j, k*l*c] "
+            "layout and the weight tensor disagree"
+        )
+
+
 def conv4d_packed(xp, w, kl_shape, bias=None, impl="scan", interpret=None):
     """4D convolution on the fused layout ``[b, i, j, k*l*c]`` (c fastest).
 
@@ -343,7 +356,7 @@ def conv4d_packed(xp, w, kl_shape, bias=None, impl="scan", interpret=None):
 
         k, l = kl_shape
         cin, cout = w.shape[-2], w.shape[-1]
-        assert k * l * cin == xp.shape[-1], (kl_shape, cin, xp.shape)
+        _check_packed(kl_shape, cin, xp.shape[-1])
         b = jnp.zeros((cout,), jnp.float32) if bias is None else bias
         # Interpret mode runs the kernel in the Pallas interpreter so the
         # CPU test mesh exercises the exact same code path as the TPU.
@@ -370,7 +383,7 @@ def conv4d_packed(xp, w, kl_shape, bias=None, impl="scan", interpret=None):
         k, l = kl_shape
         cin = w.shape[-2]
         cout = w.shape[-1]
-        assert k * l * cin == fused, (kl_shape, cin, fused)
+        _check_packed(kl_shape, cin, fused)
         out = conv4d(xp.reshape(b, i, j, k, l, cin), w, bias=bias, impl=impl)
         return out.reshape(b, i, j, k * l * cout)
     ki = w.shape[0]
@@ -379,7 +392,7 @@ def conv4d_packed(xp, w, kl_shape, bias=None, impl="scan", interpret=None):
     k, l = kl_shape
     cin = w.shape[-2]
     cout = w.shape[-1]
-    assert k * l * cin == fused, (kl_shape, cin, fused)
+    _check_packed(kl_shape, cin, fused)
     dn3 = lax.conv_dimension_numbers(
         (b, j, k, l, cin), w.shape[1:], ("NjklC", "jklIO", "NjklC")
     )

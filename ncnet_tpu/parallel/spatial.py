@@ -49,7 +49,12 @@ def mutual_matching_sharded(corr, axis_name, eps=1e-5):
 def halo_exchange_rows(x, axis_name, halo):
     """Concatenate ``halo`` rows of dim 1 from ring neighbours (zeros at the
     ends — matching zero padding)."""
-    n = lax.axis_size(axis_name)
+    # lax.axis_size only exists on newer jax; psum of 1 is the portable
+    # spelling of "how many devices on this axis"
+    n = (
+        lax.axis_size(axis_name) if hasattr(lax, "axis_size")
+        else lax.psum(1, axis_name)
+    )
     fwd = [(i, i + 1) for i in range(n - 1)]  # send right
     bwd = [(i + 1, i) for i in range(n - 1)]  # send left
     from_left = lax.ppermute(x[:, -halo:], axis_name, fwd)
@@ -148,13 +153,27 @@ def make_sharded_match_pipeline(config, mesh, axis_name="spatial"):
 
     spec = P(None, axis_name)
     out_specs = (spec, (spec, spec, spec, spec)) if k > 1 else spec
-    mapped = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(P(), spec, P()),
-        out_specs=out_specs,
-        check_vma=False,
-    )
+    # API shim: jax >= 0.6 exposes jax.shard_map (replication checking
+    # flag spelled check_vma); 0.4.x only has the experimental module
+    # (flag spelled check_rep). Same semantics either way.
+    if hasattr(jax, "shard_map"):
+        mapped = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), spec, P()),
+            out_specs=out_specs,
+            check_vma=False,
+        )
+    else:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        mapped = _shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), spec, P()),
+            out_specs=out_specs,
+            check_rep=False,
+        )
 
     def pipeline(nc_params, feat_a, feat_b):
         if feat_a.shape[1] % (n_shards * k):

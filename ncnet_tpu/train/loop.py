@@ -16,6 +16,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ncnet_tpu.analysis import sanitizer
 from ncnet_tpu.parallel.mesh import make_hybrid_mesh, replicate, shard_batch
 from ncnet_tpu.train.checkpoint import CheckpointData, save_checkpoint
 from ncnet_tpu.train.step import (
@@ -147,6 +148,14 @@ def train(
                     profiling = False
                     print(f"profile trace written to {profile_dir}", flush=True)
             state, loss = train_step(state, dbatch)
+            if sanitizer.is_enabled():
+                # sanitized runs are diagnostic: pay a per-step D2H sync so
+                # a non-finite loss stops IMMEDIATELY with the per-stage
+                # report + first non-finite stage, instead of averaging
+                # NaN into the epoch
+                sanitizer.check_finite_or_report(
+                    float(loss), context=f"epoch {epoch + 1} step {i + 1}"
+                )
             if (i + 1) % log_every == 0:
                 # the float() D2H sync makes the step timing honest
                 loss_host = float(loss)
@@ -225,4 +234,6 @@ def train(
             ),
             is_best=is_best,
         )
+    if sanitizer.is_enabled():
+        print(sanitizer.report_text(), flush=True)
     return state, {"train_loss": train_hist, "val_loss": val_hist}

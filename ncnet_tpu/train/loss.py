@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ncnet_tpu.analysis import sanitizer
 from ncnet_tpu.models.immatchnet import extract_features, match_pipeline
 
 
@@ -94,8 +95,12 @@ def weak_loss(params, config, batch, normalization="softmax"):
         corr_pos = match_pipeline(nc_params, config, fa, fb)
         corr_neg = match_pipeline(nc_params, config, fan, fb)
         return (
-            match_score_per_sample(corr_pos, normalization),
-            match_score_per_sample(corr_neg, normalization),
+            sanitizer.tap(
+                "score_pos", match_score_per_sample(corr_pos, normalization)
+            ),
+            sanitizer.tap(
+                "score_neg", match_score_per_sample(corr_neg, normalization)
+            ),
         )
 
     chunk = getattr(config, "loss_chunk", 0) or 0
@@ -109,7 +114,7 @@ def weak_loss(params, config, batch, normalization="softmax"):
             policy=jax.checkpoint_policies.save_only_these_names("nc_conv"),
         )
         pos, neg = remat_fn(feat_a, feat_b, feat_a_neg)
-        return jnp.mean(neg) - jnp.mean(pos)
+        return sanitizer.tap("weak_loss", jnp.mean(neg) - jnp.mean(pos))
     if 0 < chunk < b:
         if b % chunk:
             raise ValueError(f"batch {b} not divisible by loss_chunk {chunk}")
@@ -136,9 +141,17 @@ def weak_loss(params, config, batch, normalization="softmax"):
                 ),
             )
         pos, neg = lax.map(chunk_fn, chunks)
+        # JAX drops debug callbacks from the PRIMAL pass of a
+        # differentiated lax.map (they fire again only when the remat'd
+        # backward re-runs the body), so under grad the in-chunk stage
+        # probes go silent on the no-remat path; probing the stacked
+        # chunk outputs here keeps score-level visibility regardless
+        # (see analysis/sanitizer.py "Coverage under lax.map")
+        pos = sanitizer.tap("score_pos_chunks", pos)
+        neg = sanitizer.tap("score_neg_chunks", neg)
         score_pos, score_neg = jnp.mean(pos), jnp.mean(neg)
     else:
         pos, neg = pair_scores(feat_a, feat_b, feat_a_neg)
         score_pos, score_neg = jnp.mean(pos), jnp.mean(neg)
 
-    return score_neg - score_pos
+    return sanitizer.tap("weak_loss", score_neg - score_pos)

@@ -11,6 +11,7 @@ from typing import Any, NamedTuple
 import jax
 import optax
 
+from ncnet_tpu.analysis import sanitizer
 from ncnet_tpu.train.loss import weak_loss
 
 
@@ -137,7 +138,11 @@ def make_train_step(
             state.params, train_fe, fe_finetune_blocks, cnn
         )
         loss, grads = jax.value_and_grad(loss_fn)(trainable, state.params, batch)
+        # identity unless --sanitize: the gradient pytree is where bf16
+        # blowups surface after the forward still looks finite
+        grads = sanitizer.sanitize_pytree("grad", grads)
         updates, opt_state = optimizer.update(grads, state.opt_state, trainable)
+        updates = sanitizer.sanitize_pytree("update", updates)
         new_trainable = optax.apply_updates(trainable, updates)
         params = merge_trainable(state.params, new_trainable, cnn)
         return (

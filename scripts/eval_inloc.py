@@ -51,7 +51,15 @@ def main():
                         "device — ~4x less transfer for panos. Requires "
                         "--device_preprocess; downscaled images (queries) "
                         "keep the host resize either way. Default: on "
-                        "whenever --device_preprocess is on")
+                        "whenever --device_preprocess is on. NOTE: "
+                        "upscaled originals ship UNQUANTIZED, so each "
+                        "distinct original image size costs one extra "
+                        "jit compile of the device resize (free on real "
+                        "InLoc — panos are uniformly 1600x1200; turn "
+                        "this off for datasets with many heterogeneous "
+                        "original sizes). The upscale check is area-"
+                        "based and assumes the aspect-preserving resize "
+                        "rule (see eval/inloc.py:load_and_preprocess)")
     p.add_argument("--spatial_shards", type=int, default=0,
                    help="shard the correlation pipeline over this many "
                         "devices ('spatial' mesh axis) for grids beyond "

@@ -1,0 +1,16 @@
+"""Repo lint gate: `python scripts/lint.py ncnet_tpu scripts benchmarks`.
+
+Thin wrapper over `ncnet_tpu.analysis.cli` (the `nclint` console script of
+an installed package); the sys.path insert keeps it runnable straight from
+a checkout.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ncnet_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

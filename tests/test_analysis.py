@@ -1,0 +1,444 @@
+"""Golden-file tests for the static lint suite (ncnet_tpu.analysis).
+
+Each rule gets at least one known-bad snippet (expected finding) and one
+known-good snippet (clean) — the executable form of the rule catalog in
+ncnet_tpu/analysis/README.md — plus suppression-contract tests and the
+repo-wide zero-findings gate that makes the rules a permanent property of
+the codebase rather than a one-off review.
+"""
+
+import os
+
+import pytest
+
+from ncnet_tpu.analysis import rules  # noqa: F401  (registers the rule set)
+from ncnet_tpu.analysis.engine import (
+    RULES,
+    SEVERITY_ORDER,
+    lint_paths,
+    lint_source,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def findings_for(src, path="snippet.py", only=None):
+    out = lint_source(src, path)
+    if only:
+        out = [f for f in out if f.rule == only]
+    return out
+
+
+def rule_ids(src, path="snippet.py"):
+    return [f.rule for f in lint_source(src, path)]
+
+
+# --- bare-assert ------------------------------------------------------------
+
+
+BAD_ASSERT = """
+def combine(parts, both_directions):
+    assert both_directions, "combined output implies both_directions"
+    return parts
+"""
+
+CLEAN_ASSERT = """
+def combine(parts, both_directions):
+    if not both_directions:
+        raise ValueError("combined output implies both_directions")
+    return parts
+"""
+
+
+def test_bare_assert_bad():
+    fs = findings_for(BAD_ASSERT, only="bare-assert")
+    assert len(fs) == 1
+    assert fs[0].line == 3
+    assert fs[0].severity == "warning"
+
+
+def test_bare_assert_clean():
+    assert findings_for(CLEAN_ASSERT, only="bare-assert") == []
+
+
+def test_bare_assert_exempts_test_files():
+    """pytest-style asserts in test code are the POINT of test code."""
+    assert findings_for(BAD_ASSERT, path="tests/test_foo.py") == []
+    assert findings_for(BAD_ASSERT, path="test_foo.py") == []
+
+
+# --- host-sync-in-jit -------------------------------------------------------
+
+
+BAD_SYNC_DECORATOR = """
+import jax
+
+@jax.jit
+def f(x):
+    print("value:", x)
+    return x * 2
+"""
+
+BAD_SYNC_WRAPPED = """
+import jax
+
+def f(x):
+    return float(x) * 2
+
+g = jax.jit(f)
+"""
+
+BAD_SYNC_TRANSITIVE = """
+import jax
+import numpy as np
+from jax import lax
+
+def helper(x):
+    return np.asarray(x)
+
+def body(c):
+    return helper(c)
+
+out = lax.map(body, xs)
+"""
+
+BAD_SYNC_ITEM = """
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnums=(1,))
+def f(x, n):
+    return x.item() + n
+"""
+
+CLEAN_SYNC = """
+import jax
+
+@jax.jit
+def f(x):
+    jax.debug.print("value: {}", x)
+    return x * 2
+
+def host_loop(xs):
+    for x in xs:
+        print(float(f(x)))  # host side: sync is the point
+"""
+
+CLEAN_SYNC_MODULE_ATTR = """
+import jax
+import scipy.io as sio
+
+@jax.jit
+def f(x):
+    return x * 2
+
+def dump(path, x):
+    sio.savemat(path, {"x": x})
+"""
+
+
+def test_host_sync_decorated():
+    fs = findings_for(BAD_SYNC_DECORATOR, only="host-sync-in-jit")
+    assert len(fs) == 1 and fs[0].line == 6
+
+
+def test_host_sync_wrapped_function():
+    fs = findings_for(BAD_SYNC_WRAPPED, only="host-sync-in-jit")
+    assert len(fs) == 1 and "float()" in fs[0].message
+
+
+def test_host_sync_transitive_local_call():
+    """body -> helper propagation: the sync hides one call away from the
+    lax.map root."""
+    fs = findings_for(BAD_SYNC_TRANSITIVE, only="host-sync-in-jit")
+    assert len(fs) == 1 and "asarray" in fs[0].message
+
+
+def test_host_sync_item_method_partial_jit():
+    fs = findings_for(BAD_SYNC_ITEM, only="host-sync-in-jit")
+    assert len(fs) == 1 and ".item()" in fs[0].message
+
+
+def test_host_sync_clean():
+    assert findings_for(CLEAN_SYNC, only="host-sync-in-jit") == []
+    assert findings_for(CLEAN_SYNC_MODULE_ATTR, only="host-sync-in-jit") == []
+
+
+# --- unguarded-division -----------------------------------------------------
+
+
+BAD_DIV_INLINE = """
+import jax.numpy as jnp
+
+def mutual(corr):
+    return corr / jnp.max(corr, axis=(1, 2), keepdims=True)
+"""
+
+BAD_DIV_NAMED = """
+import jax.numpy as jnp
+
+def l1(x):
+    denom = jnp.sum(x, axis=1, keepdims=True)
+    return x / denom
+"""
+
+CLEAN_DIV_EPS = """
+import jax.numpy as jnp
+
+def mutual(corr, eps=1e-5):
+    return corr / (jnp.max(corr, axis=(1, 2), keepdims=True) + eps)
+
+def l1(x):
+    denom = jnp.sum(x, axis=1, keepdims=True) + 1e-4
+    return x / denom
+
+def norm(x, eps=1e-6):
+    denom = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True) + eps)
+    return x / denom
+"""
+
+CLEAN_DIV_HOST = """
+import numpy as np
+
+def host_stat(x):
+    return x / np.max(x)  # host fp64 pipeline: out of bf16 scope
+"""
+
+CLEAN_DIV_CLAMPED = """
+import jax.numpy as jnp
+
+def safe(x):
+    return x / jnp.maximum(jnp.max(x, axis=1), 1e-6)
+"""
+
+
+def test_unguarded_division_inline():
+    fs = findings_for(BAD_DIV_INLINE, only="unguarded-division")
+    assert len(fs) == 1 and fs[0].line == 5
+
+
+def test_unguarded_division_through_assignment():
+    fs = findings_for(BAD_DIV_NAMED, only="unguarded-division")
+    assert len(fs) == 1 and fs[0].line == 6
+
+
+def test_unguarded_division_clean():
+    assert findings_for(CLEAN_DIV_EPS, only="unguarded-division") == []
+    assert findings_for(CLEAN_DIV_HOST, only="unguarded-division") == []
+    assert findings_for(CLEAN_DIV_CLAMPED, only="unguarded-division") == []
+
+
+# --- unstable-exp -----------------------------------------------------------
+
+
+BAD_EXP = """
+import jax.numpy as jnp
+
+def softmax(logits, axis):
+    e = jnp.exp(logits)
+    return e / (jnp.sum(e, axis=axis, keepdims=True) + 1e-9)
+"""
+
+CLEAN_EXP = """
+import jax
+import jax.numpy as jnp
+
+def softmax(logits, axis):
+    return jax.nn.softmax(logits, axis=axis)
+
+def stable(logits, axis):
+    e = jnp.exp(logits - jnp.max(logits, axis=axis, keepdims=True))
+    return e / (jnp.sum(e, axis=axis, keepdims=True) + 1e-9)
+
+def decay(d2, sigma):
+    return jnp.exp(-d2 / (2 * sigma**2))
+"""
+
+
+def test_unstable_exp_bad():
+    fs = findings_for(BAD_EXP, only="unstable-exp")
+    assert len(fs) == 1 and fs[0].line == 5
+
+
+def test_unstable_exp_clean():
+    assert findings_for(CLEAN_EXP, only="unstable-exp") == []
+
+
+# --- traced-python-branch ---------------------------------------------------
+
+
+BAD_BRANCH = """
+import jax.numpy as jnp
+
+def f(x):
+    if jnp.any(x > 0):
+        return x
+    return -x
+"""
+
+CLEAN_BRANCH = """
+import jax.numpy as jnp
+
+def f(x, flag):
+    if flag and x.shape[0] > 2:
+        return x
+    if jnp.asarray(x).dtype == jnp.float32:
+        return x * 2
+    return jnp.where(x > 0, x, -x)
+"""
+
+
+def test_traced_branch_bad():
+    fs = findings_for(BAD_BRANCH, only="traced-python-branch")
+    assert len(fs) == 1 and "jax.numpy.any" in fs[0].message
+
+
+def test_traced_branch_clean():
+    """shape/dtype metadata is static under jit; branching on it is the
+    normal way to specialize a trace."""
+    assert findings_for(CLEAN_BRANCH, only="traced-python-branch") == []
+
+
+# --- mutable-default-arg ----------------------------------------------------
+
+
+BAD_DEFAULT = """
+def collect(x, acc=[]):
+    acc.append(x)
+    return acc
+"""
+
+CLEAN_DEFAULT = """
+def collect(x, acc=None):
+    acc = [] if acc is None else acc
+    acc.append(x)
+    return acc
+
+def sized(x, shape=(3, 3)):
+    return x.reshape(shape)
+"""
+
+
+def test_mutable_default_bad():
+    fs = findings_for(BAD_DEFAULT, only="mutable-default-arg")
+    assert len(fs) == 1
+
+
+def test_mutable_default_clean():
+    assert findings_for(CLEAN_DEFAULT, only="mutable-default-arg") == []
+
+
+# --- suppressions -----------------------------------------------------------
+
+
+def test_suppression_with_reason_silences():
+    src = BAD_ASSERT.replace(
+        'assert both_directions, "combined output implies both_directions"',
+        'assert both_directions  '
+        "# nclint: disable=bare-assert -- exercised only from the owning "
+        "test harness",
+    )
+    assert findings_for(src) == []
+
+
+def test_suppression_without_reason_is_an_error():
+    src = BAD_ASSERT.replace(
+        'assert both_directions, "combined output implies both_directions"',
+        "assert both_directions  # nclint: disable=bare-assert",
+    )
+    fs = findings_for(src)
+    assert [f.rule for f in fs] == ["bad-suppression"]
+    assert fs[0].severity == "error"
+
+
+def test_suppression_for_other_rule_does_not_apply():
+    src = BAD_ASSERT.replace(
+        'assert both_directions, "combined output implies both_directions"',
+        "assert both_directions  "
+        "# nclint: disable=unstable-exp -- wrong rule on purpose",
+    )
+    assert [f.rule for f in findings_for(src)] == ["bare-assert"]
+
+
+# --- engine / CLI -----------------------------------------------------------
+
+
+def test_rule_catalog_size_and_severities():
+    """The catalog the acceptance criteria count: >= 5 distinct rules, all
+    gate-relevant (warning or stronger)."""
+    assert len(RULES) >= 5
+    for r in RULES.values():
+        assert SEVERITY_ORDER[r.severity] >= SEVERITY_ORDER["warning"]
+        assert r.doc.strip(), f"rule {r.rule_id} has no catalog doc"
+
+
+def test_syntax_error_reported_not_raised():
+    fs = lint_source("def broken(:\n", "bad.py")
+    assert [f.rule for f in fs] == ["syntax-error"]
+
+
+def test_cli_bad_tree_and_select(tmp_path, capsys):
+    from ncnet_tpu.analysis.cli import main
+
+    bad = tmp_path / "mod.py"
+    bad.write_text(BAD_EXP)
+    assert main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "unstable-exp" in out
+
+    # --select narrows the rule set; a clean selection exits 0
+    assert main([str(bad), "--select", "bare-assert"]) == 0
+
+
+def test_cli_json_output(tmp_path, capsys):
+    import json
+
+    from ncnet_tpu.analysis.cli import main
+
+    bad = tmp_path / "mod.py"
+    bad.write_text(BAD_DEFAULT)
+    assert main([str(bad), "--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["count"] == 1
+    assert payload["findings"][0]["rule"] == "mutable-default-arg"
+
+
+# --- the repo-wide gate -----------------------------------------------------
+
+
+def test_repo_lint_gate_zero_findings():
+    """CI gate: the whole library + scripts + benchmarks tree is clean at
+    severity >= warning (suppressions, each with a mandatory reason, are
+    the only escape hatch). Equivalent to:
+
+        python scripts/lint.py ncnet_tpu scripts benchmarks
+    """
+    paths = [os.path.join(REPO, d)
+             for d in ("ncnet_tpu", "scripts", "benchmarks")]
+    findings = lint_paths(paths)
+    gating = [
+        f for f in findings
+        if SEVERITY_ORDER[f.severity] >= SEVERITY_ORDER["warning"]
+    ]
+    assert not gating, "\n" + "\n".join(f.format() for f in gating)
+
+
+def test_repo_suppressions_all_carry_reasons():
+    """Every inline suppression in the linted tree parses with a reason —
+    the bad-suppression error path of the gate, asserted directly."""
+    from ncnet_tpu.analysis.engine import _SUPPRESS_RE, iter_python_files
+
+    paths = [os.path.join(REPO, d)
+             for d in ("ncnet_tpu", "scripts", "benchmarks")]
+    n_directives = 0
+    for path in iter_python_files(paths):
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                m = _SUPPRESS_RE.search(line)
+                if m:
+                    n_directives += 1
+                    assert (m.group(2) or "").strip(), (
+                        f"suppression without reason in {path}: "
+                        f"{line.strip()}"
+                    )
+    assert n_directives >= 1, "expected at least one real suppression"
