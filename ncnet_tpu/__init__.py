@@ -20,7 +20,16 @@ Design notes (TPU-first, not a port):
     correlation tensor (the long-context analog) with halo exchange.
 """
 
-from ncnet_tpu import analysis, data, models, ops, parallel, train, utils
+from ncnet_tpu import (
+    analysis,
+    data,
+    models,
+    ops,
+    parallel,
+    resilience,
+    train,
+    utils,
+)
 from ncnet_tpu.models.immatchnet import ImMatchNet, ImMatchNetConfig
 
 __version__ = "0.1.0"  # keep in sync with pyproject.toml
@@ -33,6 +42,7 @@ __all__ = [
     "models",
     "ops",
     "parallel",
+    "resilience",
     "train",
     "utils",
 ]

@@ -5,12 +5,15 @@ rounds 1-5) or the TPU-compilation literature (arXiv:1810.09868 catalogs
 host-sync and shape-driven-recompile trace hazards; the sparse-NCNet line,
 arXiv:2004.10566, the low-precision normalization fragility):
 
-  bare-assert            contracts stripped under ``python -O``
-  host-sync-in-jit       host synchronization reachable inside compiled code
-  unguarded-division     ``x / reduction(..)`` without an epsilon guard
-  unstable-exp           ``jnp.exp`` without max-subtraction (bf16 overflow)
-  traced-python-branch   Python control flow on a traced jnp value
-  mutable-default-arg    shared mutable default arguments
+  bare-assert               contracts stripped under ``python -O``
+  host-sync-in-jit          host synchronization reachable inside compiled code
+  unguarded-division        ``x / reduction(..)`` without an epsilon guard
+  unstable-exp              ``jnp.exp`` without max-subtraction (bf16 overflow)
+  traced-python-branch      Python control flow on a traced jnp value
+  mutable-default-arg       shared mutable default arguments
+  non-atomic-artifact-write checkpoint/metrics artifacts written with a bare
+                            ``open(path, "wb")`` (torn by preemption) instead
+                            of the durable temp+fsync+rename helper
 
 All rules are intentionally conservative (intra-module reasoning only, one
 level of name expansion): a finding should mean something; the escape hatch
@@ -402,6 +405,69 @@ def traced_python_branch(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
                 "sync outside it); use jnp.where or lax.cond"
             )
             break
+
+
+# --- non-atomic-artifact-write ----------------------------------------------
+
+#: substrings that mark a write target as a resume/metrics artifact — the
+#: class of file whose torn-write loses a training run, not just an output
+_ARTIFACT_HINTS = (
+    "checkpoint", "ckpt", "metrics", "msgpack", "weights", "model_best",
+    "best_",
+)
+
+
+@rule(
+    "non-atomic-artifact-write",
+    "warning",
+    doc="A checkpoint/metrics artifact written with a bare `open(path, "
+        "\"wb\")` is torn by a preemption landing mid-write — the resume "
+        "point is lost. Route it through "
+        "`ncnet_tpu.resilience.durable.durable_write_bytes` "
+        "(temp + fsync + atomic rename + sidecar digest).",
+)
+def non_atomic_artifact_write(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    if ctx.is_test:
+        return
+    # artifact-ness is judged from the names in scope: string constants and
+    # identifiers in the path expression, plus enclosing function names —
+    # conservative on purpose (a PNG/tmp-file writer should not be flagged)
+    parents: Dict[ast.AST, ast.AST] = {}
+    for parent in ast.walk(ctx.tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or ctx.canonical(node.func) != "open":
+            continue
+        mode = None
+        if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+            mode = node.args[1].value
+        for kw in node.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        if mode != "wb":
+            continue
+        hay: List[str] = []
+        if node.args:
+            for sub in ast.walk(node.args[0]):
+                if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                    hay.append(sub.value)
+                elif isinstance(sub, ast.Name):
+                    hay.append(sub.id)
+                elif isinstance(sub, ast.Attribute):
+                    hay.append(sub.attr)
+        p: ast.AST = node
+        while p in parents:
+            p = parents[p]
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                hay.append(p.name)
+        text = " ".join(hay).lower()
+        if any(h in text for h in _ARTIFACT_HINTS):
+            yield node, (
+                "non-atomic binary write of a resume-critical artifact: a "
+                "kill mid-write tears the file; use resilience.durable."
+                "durable_write_bytes (temp + fsync + rename + digest)"
+            )
 
 
 # --- mutable-default-arg ----------------------------------------------------

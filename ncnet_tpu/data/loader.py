@@ -22,8 +22,15 @@ TPU-host-first:
   so augmentation isn't duplicated (lib/dataloader.py:39-43) — is preserved
   by construction: sample RNG is derived from the sample index, so results
   are identical regardless of worker count AND backend;
-* deterministic epoch shuffling from a seed;
-* per-host sharding for multi-host data parallelism.
+* deterministic epoch shuffling from a seed, addressable by ABSOLUTE epoch
+  (`iter_epoch`) so a mid-epoch resume replays the exact batch sequence;
+* graceful degradation (production fleets see bitrot and flaky NFS):
+  per-sample retry with exponential backoff, then — within a bounded
+  ``skip_budget`` — a deterministic substitute sample instead of killing
+  the epoch; exceeding the budget still fails loudly;
+* per-host sharding for multi-host data parallelism;
+* a context manager (``with DataLoader(...) as dl:``) so the process
+  pool is shut down on every exit path, including SIGTERM preemption.
 """
 
 import queue
@@ -32,6 +39,8 @@ import time
 import traceback
 
 import numpy as np
+
+from ncnet_tpu.resilience import faultinject
 
 # process-backend worker state: the dataset object, delivered once via the
 # pool initializer (pickling it per task would dominate small-task cost)
@@ -43,9 +52,8 @@ def _process_worker_init(dataset):
     _WORKER_DATASET = dataset
 
 
-def _process_build_batch(indices):
-    ds = _WORKER_DATASET
-    return collate([ds[int(i)] for i in indices])
+def _process_build_batch(indices, retries, backoff, skip_budget):
+    return build_batch(_WORKER_DATASET, indices, retries, backoff, skip_budget)
 
 
 def collate(samples):
@@ -55,6 +63,51 @@ def collate(samples):
         vals = [s[key] for s in samples]
         out[key] = np.stack(vals).astype(vals[0].dtype, copy=False)
     return out
+
+
+def _load_sample(dataset, idx, retries, backoff):
+    """One sample with per-attempt retry + exponential backoff (transient
+    I/O: flaky NFS, racing downloads). The LAST failure propagates."""
+    for attempt in range(retries + 1):
+        try:
+            return dataset[int(idx)]
+        except Exception:
+            if attempt == retries:
+                raise
+            time.sleep(backoff * (2 ** attempt))
+
+
+def build_batch(dataset, indices, retries=0, backoff=0.05, skip_budget=0):
+    """Collate ``dataset[indices]`` with retry + bounded substitution.
+
+    A sample that still fails after ``retries`` extra attempts is skipped
+    and replaced by the next loadable index (deterministic — depends only
+    on the failing index, so batches are identical for any worker count or
+    backend). Returns ``(batch, skipped)`` where ``skipped`` lists the
+    indices abandoned; at most ``skip_budget`` substitutions happen per
+    call before the original exception propagates. Shapes stay constant
+    under substitution, so jitted steps do not recompile.
+    """
+    faultinject.fire("data.batch")
+    samples, skipped = [], []
+    for idx in indices:
+        cur = int(idx)
+        while True:
+            try:
+                samples.append(_load_sample(dataset, cur, retries, backoff))
+                break
+            except Exception:
+                skipped.append(cur)
+                if len(skipped) > skip_budget:
+                    raise
+                print(
+                    f"[loader] skipping corrupt sample {cur} "
+                    f"(substituting {(cur + 1) % len(dataset)}; "
+                    f"{len(skipped)} skipped so far)",
+                    flush=True,
+                )
+                cur = (cur + 1) % len(dataset)
+    return collate(samples), skipped
 
 
 def shard_indices(n, host_id, n_hosts):
@@ -83,7 +136,15 @@ class DataLoader:
         host_id=0,
         n_hosts=1,
         backend="thread",
+        sample_retries=2,
+        retry_backoff=0.05,
+        skip_budget=0,
     ):
+        """``sample_retries``/``retry_backoff``: extra per-sample attempts
+        for transient failures. ``skip_budget``: total corrupt samples this
+        loader may substitute (deterministically, shape-preserving) over
+        its lifetime before failing loudly; 0 keeps strict
+        fail-on-first-error semantics."""
         if backend not in ("thread", "process"):
             raise ValueError(f"unknown loader backend {backend!r}")
         self.dataset = dataset
@@ -96,6 +157,10 @@ class DataLoader:
         self.indices = shard_indices(len(dataset), host_id, n_hosts)
         self.epoch = 0
         self.backend = backend
+        self.sample_retries = sample_retries
+        self.retry_backoff = retry_backoff
+        self.skip_budget = skip_budget
+        self.skipped = []  # indices substituted so far (loader lifetime)
         self._pool = None
 
     def _process_pool(self):
@@ -113,32 +178,69 @@ class DataLoader:
         return self._pool
 
     def close(self):
+        """Shut the worker pool down (idempotent). The training path runs
+        loaders as context managers so preemption can't leak spawn
+        processes."""
         if self._pool is not None:
             self._pool.shutdown()
             self._pool = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
     def __len__(self):
         n = len(self.indices)
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
 
-    def _epoch_indices(self):
+    def _epoch_indices(self, epoch):
         idx = self.indices.copy()
         if self.shuffle:
-            np.random.RandomState(self.seed + self.epoch).shuffle(idx)
+            np.random.RandomState(self.seed + epoch).shuffle(idx)
         return idx
 
-    def __iter__(self):
-        idx = self._epoch_indices()
-        self.epoch += 1
+    def _epoch_batches(self, epoch):
+        idx = self._epoch_indices(epoch)
         batches = [
             idx[i : i + self.batch_size]
             for i in range(0, len(idx), self.batch_size)
         ]
         if self.drop_last and batches and len(batches[-1]) < self.batch_size:
             batches.pop()
+        return batches
+
+    def __iter__(self):
+        """Legacy auto-advancing iteration: epoch 0, 1, 2, ... per call.
+        Resumable training drives `iter_epoch` with the absolute epoch
+        instead, so the shuffle does not depend on iterator call count."""
+        it = self.iter_epoch(self.epoch)
+        self.epoch += 1
+        return it
+
+    def iter_epoch(self, epoch, skip_batches=0):
+        """Iterate the batches of ABSOLUTE ``epoch``, optionally skipping
+        the first ``skip_batches`` (mid-epoch resume: the skipped batches
+        are never constructed, so resume costs no wasted decode work)."""
+        batches = self._epoch_batches(epoch)[skip_batches:]
         if self.backend == "process":
             return self._iter_process(batches)
         return self._iter_thread(batches)
+
+    def _account_skips(self, skipped, cause=None):
+        """Lifetime skip-budget accounting; loud failure past the budget."""
+        if not skipped:
+            return
+        self.skipped.extend(skipped)
+        if len(self.skipped) > self.skip_budget:
+            raise RuntimeError(
+                f"corrupt-sample skip budget exhausted: "
+                f"{len(self.skipped)} samples skipped "
+                f"(budget {self.skip_budget}); first failures: "
+                f"{self.skipped[:8]}"
+            ) from cause
 
     def _iter_process(self, batches):
         import collections
@@ -149,7 +251,15 @@ class DataLoader:
         bi = 0
         while bi < len(batches) or futs:
             while bi < len(batches) and len(futs) < window:
-                futs.append(pool.submit(_process_build_batch, batches[bi]))
+                futs.append(
+                    pool.submit(
+                        _process_build_batch,
+                        batches[bi],
+                        self.sample_retries,
+                        self.retry_backoff,
+                        self.skip_budget,
+                    )
+                )
                 bi += 1
             # same error contract as the thread backend: wrap the worker
             # exception (its remote traceback rides along as __cause__).
@@ -159,11 +269,12 @@ class DataLoader:
             # the MAIN thread mid-wait and must keep its own semantics;
             # worker failures always arrive as Exception via the future
             try:
-                batch = futs.popleft().result()
+                batch, skipped = futs.popleft().result()
             except Exception as e:
                 raise RuntimeError(
                     f"data worker failed on batch construction: {e!r}"
                 ) from e
+            self._account_skips(skipped)
             yield batch
 
     def _iter_thread(self, batches):
@@ -194,7 +305,10 @@ class DataLoader:
                     inflight.release()
                     return
                 try:
-                    batch = collate([self.dataset[int(i)] for i in b])
+                    batch = build_batch(
+                        self.dataset, b, self.sample_retries,
+                        self.retry_backoff, self.skip_budget,
+                    )
                 except BaseException as e:  # noqa: BLE001 — re-raised below
                     with lock:
                         if not error:
@@ -204,7 +318,6 @@ class DataLoader:
                     return
                 with lock:
                     results[bi] = batch
-
         threads = [
             threading.Thread(target=worker, daemon=True)
             for _ in range(self.num_workers)
@@ -239,6 +352,8 @@ class DataLoader:
                     else:
                         time.sleep(0.002)
                         continue
+                batch, skipped = batch
+                self._account_skips(skipped)
                 yield batch
                 inflight.release()
                 next_bi += 1

@@ -1,0 +1,24 @@
+"""Preemption-safety toolkit: durable writes, resume cursors, fault injection.
+
+TPU fleets preempt routinely (large runs are economical precisely because
+they tolerate being killed — PAPERS.md, the Gemma-on-TPU operational
+comparison), so recovery is a feature with tests, not a hope:
+
+  * `durable` — write-to-temp + fsync + atomic-rename file writes with a
+    sidecar integrity digest, rotating retention of the last K artifacts,
+    and candidate iteration for walking back past a torn/corrupt file.
+  * `signals` — a `PreemptionGuard` context manager turning SIGTERM/SIGINT
+    into a checkpoint-once-and-exit-cleanly flag for the training loop.
+  * `faultinject` — a named-crash-point hook registry (env-var or test
+    activated, exact no-op when disabled — same contract as
+    `analysis.sanitizer`) that lets tests PROVE crash-at-any-point
+    recovery instead of asserting it in prose.
+
+Like `analysis`, this subpackage is import-light: the training loop and
+data loader import it at instrumentation points, so it must stay
+stdlib-only.
+"""
+
+from ncnet_tpu.resilience import durable, faultinject, signals
+
+__all__ = ["durable", "faultinject", "signals"]

@@ -1,0 +1,159 @@
+"""Named fault-injection points for crash/delay/corrupt testing.
+
+Recovery code that has never seen a failure is decoration. This registry
+gives the checkpoint writer, the training loop, and the data workers NAMED
+points where tests (or an operator, via environment variable) can inject
+the failures the recovery paths claim to survive:
+
+  ============================  =================================================
+  point                         site
+  ============================  =================================================
+  ``checkpoint.write``          mid-write of the checkpoint temp file (half the
+                                payload is on disk, the rename has not happened)
+  ``checkpoint.rename``         temp file complete + fsynced, rename pending
+  ``checkpoint.bytes``          the serialized payload itself (corrupt target)
+  ``step.boundary``             after each optimizer step in the training loop
+  ``data.batch``                batch construction inside a loader worker
+  ============================  =================================================
+
+Actions: ``crash`` raises :class:`InjectedFault` (unwinds normally, finally
+blocks run), ``kill`` calls ``os._exit(137)`` (a true preemption: no
+cleanup, no atexit — what SIGKILL does to a TPU worker), ``delay:<sec>``
+sleeps, ``corrupt`` flips bytes of the payload at sites that pass one.
+
+Activation mirrors `analysis.sanitizer`: exact no-op when disabled (one
+falsy-dict check per ``fire``), enabled either programmatically
+(`inject` / `configure`, for in-process tests) or via the environment
+variable consumed lazily on first use (for subprocess kill tests)::
+
+    NCNET_FAULTS="checkpoint.write=kill@1,step.boundary=crash@3"
+
+``@n`` arms the fault on the n-th hit of that point only (1-based);
+without it the fault triggers on every hit.
+"""
+
+import os
+import threading
+import time
+
+ENV_VAR = "NCNET_FAULTS"
+
+ACTIONS = ("crash", "kill", "delay", "corrupt")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a ``crash`` action; never raised by production code paths."""
+
+
+class _Fault:
+    __slots__ = ("action", "arg", "at", "hits")
+
+    def __init__(self, action, arg=None, at=None):
+        if action not in ACTIONS:
+            raise ValueError(
+                f"unknown fault action {action!r} (choose from {ACTIONS})"
+            )
+        self.action = action
+        self.arg = arg
+        self.at = at  # 1-based hit index to trigger on; None = every hit
+        self.hits = 0
+
+
+_lock = threading.Lock()
+_faults = {}  # point name -> _Fault
+_env_loaded = False
+
+
+def clear():
+    """Drop all injected faults and forget the env var was ever read."""
+    global _env_loaded
+    with _lock:
+        _faults.clear()
+        _env_loaded = True  # an explicit clear() beats a stale env var
+
+
+def inject(point, action, arg=None, at=None):
+    """Arm ``point`` with ``action`` (see module docstring); test API."""
+    with _lock:
+        _faults[point] = _Fault(action, arg, at)
+
+
+def configure(spec):
+    """Parse a ``point=action[:arg][@n],...`` spec (the env-var grammar)."""
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        point, _, rhs = item.partition("=")
+        if not rhs:
+            raise ValueError(
+                f"malformed fault spec {item!r}: expected point=action[:arg][@n]"
+            )
+        rhs, _, at = rhs.partition("@")
+        action, _, arg = rhs.partition(":")
+        inject(
+            point.strip(),
+            action.strip(),
+            arg=float(arg) if arg else None,
+            at=int(at) if at else None,
+        )
+
+
+def _ensure_env_loaded():
+    global _env_loaded
+    with _lock:
+        if _env_loaded:
+            return
+        _env_loaded = True
+    spec = os.environ.get(ENV_VAR, "")
+    if spec:
+        configure(spec)
+
+
+def is_enabled():
+    _ensure_env_loaded()
+    return bool(_faults)
+
+
+def _armed(point):
+    """Count a hit; return the fault iff it should trigger now."""
+    _ensure_env_loaded()
+    if not _faults:  # the disabled fast path: one dict truthiness check
+        return None
+    with _lock:
+        fault = _faults.get(point)
+        if fault is None:
+            return None
+        fault.hits += 1
+        if fault.at is not None and fault.hits != fault.at:
+            return None
+        return fault
+
+
+def fire(point, data=None):
+    """Hit a named fault point; returns ``data`` (possibly corrupted).
+
+    Exact no-op when no fault is armed: returns ``data`` unchanged after a
+    single falsy-dict check, so production paths pay nothing.
+    """
+    fault = _armed(point)
+    if fault is None:
+        return data
+    if fault.action == "crash":
+        raise InjectedFault(f"injected crash at fault point {point!r}")
+    if fault.action == "kill":
+        print(f"[faultinject] hard kill at {point!r}", flush=True)
+        os._exit(137)  # preemption semantics: no finally, no atexit
+    if fault.action == "delay":
+        time.sleep(fault.arg if fault.arg is not None else 0.1)
+        return data
+    # corrupt: only meaningful at sites that pass the payload through
+    if data is None:
+        return None
+    blob = bytearray(data)
+    if blob:
+        # flip a spread of bits so truncation-style AND bitrot-style
+        # detectors both see damage
+        for off in range(0, len(blob), max(1, len(blob) // 8)):
+            blob[off] ^= 0xFF
+    return bytes(blob)

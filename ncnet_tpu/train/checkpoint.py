@@ -1,19 +1,27 @@
-"""Self-describing checkpoints.
+"""Self-describing, preemption-safe checkpoints.
 
 Like the reference's ``save_checkpoint`` (lib/torch_util.py:48-61,
 train.py:197-205) a checkpoint carries the architecture config with the
 weights, so eval tools need no flags. Unlike the reference, optimizer state
 and the step counter are saved too, making resume exact rather than
-weights-only (SURVEY.md §5 notes the reference's resume drops them).
+weights-only (SURVEY.md §5 notes the reference's resume drops them), plus a
+LOADER CURSOR (epoch, batch index, shuffle seed, per-step losses of the
+in-flight epoch) so a preempted run resumes mid-epoch, not at the last
+epoch boundary.
 
 Format: a single msgpack file (flax.serialization) holding numpy-fied
 pytrees, plus the config as a plain dict. A ``best_<name>`` copy is written
 when the validation loss improves, mirroring the reference.
+
+Durability (ncnet_tpu.resilience.durable): every file — main and best —
+is written temp + fsync + atomic rename with a ``<path>.sha256`` sidecar
+verified at load; the last ``keep`` saves are retained as hardlinked
+``<path>.step<N>`` history so `load_latest_valid` can walk back past a
+torn or corrupt latest file instead of crashing the resume.
 """
 
 import dataclasses
 import os
-import shutil
 from typing import Any, Optional
 
 import jax
@@ -21,6 +29,7 @@ import numpy as np
 from flax import serialization
 
 from ncnet_tpu.models.immatchnet import ImMatchNetConfig
+from ncnet_tpu.resilience import durable
 
 
 @dataclasses.dataclass
@@ -37,6 +46,10 @@ class CheckpointData:
     # rebuild the same trainable subset or from_state_dict fails opaquely
     train_fe: bool = False
     fe_finetune_blocks: int = 0
+    # mid-epoch resume cursor: {"epoch": int, "batch_index": int,
+    # "shuffle_seed": int, "epoch_losses": [float, ...]}. None for
+    # epoch-boundary checkpoints (nothing in flight).
+    cursor: Optional[dict] = None
 
 
 def _to_numpy(tree):
@@ -54,7 +67,36 @@ def _relistify(obj):
     return obj
 
 
-def save_checkpoint(path, data: CheckpointData, is_best=False):
+def _cursor_payload(cursor):
+    if cursor is None:
+        return {}
+    return {
+        "epoch": int(cursor.get("epoch", 0)),
+        "batch_index": int(cursor.get("batch_index", 0)),
+        "shuffle_seed": int(cursor.get("shuffle_seed", 0)),
+        # float64 keeps the host-side float(loss) values bit-exact, so a
+        # resumed epoch's mean loss equals the uninterrupted run's
+        "epoch_losses": np.asarray(
+            cursor.get("epoch_losses", []), np.float64
+        ),
+    }
+
+
+def _cursor_from_payload(payload):
+    cur = payload.get("cursor") or None
+    if not cur:
+        return None
+    return {
+        "epoch": int(cur.get("epoch", 0)),
+        "batch_index": int(cur.get("batch_index", 0)),
+        "shuffle_seed": int(cur.get("shuffle_seed", 0)),
+        "epoch_losses": [
+            float(v) for v in np.asarray(cur.get("epoch_losses", [])).ravel()
+        ],
+    }
+
+
+def serialize_checkpoint(data: CheckpointData) -> bytes:
     payload = {
         "config": data.config.to_dict(),
         "params": serialization.to_state_dict(_to_numpy(data.params)),
@@ -74,21 +116,39 @@ def save_checkpoint(path, data: CheckpointData, is_best=False):
         ),
         "train_fe": bool(data.train_fe),
         "fe_finetune_blocks": int(data.fe_finetune_blocks),
+        "cursor": _cursor_payload(data.cursor),
     }
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(path, "wb") as f:
-        f.write(serialization.msgpack_serialize(payload))
+    return serialization.msgpack_serialize(payload)
+
+
+def save_checkpoint(path, data: CheckpointData, is_best=False, keep=3):
+    """Durably write ``path`` (and ``best_<name>`` when ``is_best``).
+
+    Both files go through temp + fsync + atomic rename with a sidecar
+    digest (a kill mid-write leaves the PREVIOUS checkpoint intact), and
+    the newest ``keep`` saves are retained as ``<path>.step<N>`` history
+    for `load_latest_valid` to fall back on.
+    """
+    path = os.path.abspath(path)
+    blob = serialize_checkpoint(data)
+    durable.durable_write_bytes(path, blob)
+    durable.retain(path, data.step, keep=keep)
     if is_best:
+        # the same durable path as the main file: the old shutil.copyfile
+        # could be observed half-written by a concurrent eval/preemption
         base = os.path.basename(path)
-        best = os.path.join(os.path.dirname(os.path.abspath(path)), "best_" + base)
-        shutil.copyfile(path, best)
+        best = os.path.join(os.path.dirname(path), "best_" + base)
+        durable.durable_write_bytes(best, blob)
 
 
 def load_checkpoint(path, opt_state_target=None) -> CheckpointData:
-    """Load a checkpoint. To restore optimizer state into the right pytree
-    structure, pass a freshly-initialized ``opt_state_target``."""
-    with open(path, "rb") as f:
-        payload = serialization.msgpack_restore(f.read())
+    """Load a checkpoint, verifying the sidecar digest when present (raises
+    ``resilience.durable.IntegrityError`` on mismatch). To restore optimizer
+    state into the right pytree structure, pass a freshly-initialized
+    ``opt_state_target``."""
+    payload = serialization.msgpack_restore(
+        durable.read_verified_bytes(path)
+    )
     config = ImMatchNetConfig.from_dict(payload["config"])
     opt_state = payload.get("opt_state") or None
     if opt_state is not None and opt_state_target is not None:
@@ -104,4 +164,15 @@ def load_checkpoint(path, opt_state_target=None) -> CheckpointData:
         best_val_loss=payload.get("best_val_loss"),
         train_fe=bool(payload.get("train_fe", False)),
         fe_finetune_blocks=int(payload.get("fe_finetune_blocks", 0)),
+        cursor=_cursor_from_payload(payload),
+    )
+
+
+def load_latest_valid(path, opt_state_target=None):
+    """Load the newest checkpoint that verifies AND parses, walking back
+    through the main file and its ``.step<N>`` history past torn/corrupt
+    files. Returns ``(CheckpointData, used_path)``; raises
+    ``FileNotFoundError`` when no candidate loads."""
+    return durable.latest_valid(
+        path, lambda p: load_checkpoint(p, opt_state_target=opt_state_target)
     )

@@ -5,6 +5,16 @@ passes over CSV pair datasets, checkpoint each epoch with a ``best_`` copy
 on improved validation loss, loss histories stored in the checkpoint.
 Improvements over the reference: exact resume (optimizer state + epoch),
 data-parallel over a device mesh, donate-args jitted step.
+
+Preemption safety (ncnet_tpu.resilience): checkpoints are durable
+(temp + fsync + rename + digest + rotation); ``save_every_steps`` writes
+mid-epoch snapshots carrying a loader cursor (epoch, batch index, shuffle
+seed, the in-flight epoch's per-step losses) so a killed run resumes at
+the exact step — bitwise-identical to never having been killed; a
+``preemption`` guard (resilience.signals.PreemptionGuard) turns
+SIGTERM/SIGINT into one final cursor checkpoint and a clean return. The
+loader is driven by ABSOLUTE epoch (`iter_epoch`) so epoch shuffles are
+identical whether or not the run was ever restarted.
 """
 
 import json
@@ -18,6 +28,7 @@ import jax.numpy as jnp
 
 from ncnet_tpu.analysis import sanitizer
 from ncnet_tpu.parallel.mesh import make_hybrid_mesh, replicate, shard_batch
+from ncnet_tpu.resilience import faultinject
 from ncnet_tpu.train.checkpoint import CheckpointData, save_checkpoint
 from ncnet_tpu.train.step import (
     create_train_state,
@@ -63,6 +74,29 @@ def _prefetch_device_batches(mesh, loader, size=2):
         enqueue()
 
 
+def _epoch_iter(loader, epoch, skip=0):
+    """Drive a loader by ABSOLUTE epoch when it supports `iter_epoch`
+    (resume-correct shuffle: the epoch-e batch sequence is the same
+    whether or not the process was ever restarted). Plain iterables (tests
+    pass lists of batches) fall back to their own ordering."""
+    if hasattr(loader, "iter_epoch"):
+        return loader.iter_epoch(epoch, skip_batches=skip)
+    it = iter(loader)
+    for _ in range(skip):
+        next(it, None)
+    return it
+
+
+def _close_quietly(*loaders):
+    for loader in loaders:
+        close = getattr(loader, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception as e:  # cleanup must never mask the real exit
+                print(f"loader close failed: {e!r}", flush=True)
+
+
 def train(
     config,
     params,
@@ -77,6 +111,8 @@ def train(
     data_parallel=True,
     start_epoch=0,
     start_step=0,
+    start_batch=0,
+    start_epoch_losses=None,
     opt_state=None,
     initial_best_val=None,
     initial_train_hist=None,
@@ -84,6 +120,40 @@ def train(
     log_every=10,
     profile_dir=None,
     profile_steps=(3, 8),
+    save_every_steps=0,
+    keep_checkpoints=3,
+    preemption=None,
+):
+    """Run the training loop; returns ``(state, history)``.
+
+    Resilience knobs: ``start_batch``/``start_epoch_losses`` resume
+    mid-epoch from a checkpoint cursor; ``save_every_steps > 0`` writes a
+    durable cursor snapshot every N steps; ``preemption`` (an object with
+    a ``requested`` flag, e.g. `resilience.signals.PreemptionGuard`)
+    triggers one final snapshot and a clean early return —
+    ``history["preempted"]`` reports which way the loop ended. Loaders
+    exposing ``close()`` are closed on every exit path.
+    """
+    try:
+        return _train_impl(
+            config, params, train_loader, val_loader, num_epochs,
+            learning_rate, train_fe, fe_finetune_blocks, checkpoint_dir,
+            checkpoint_name, data_parallel, start_epoch, start_step,
+            start_batch, start_epoch_losses, opt_state, initial_best_val,
+            initial_train_hist, initial_val_hist, log_every, profile_dir,
+            profile_steps, save_every_steps, keep_checkpoints, preemption,
+        )
+    finally:
+        _close_quietly(train_loader, val_loader)
+
+
+def _train_impl(
+    config, params, train_loader, val_loader, num_epochs, learning_rate,
+    train_fe, fe_finetune_blocks, checkpoint_dir, checkpoint_name,
+    data_parallel, start_epoch, start_step, start_batch, start_epoch_losses,
+    opt_state, initial_best_val, initial_train_hist, initial_val_hist,
+    log_every, profile_dir, profile_steps, save_every_steps,
+    keep_checkpoints, preemption,
 ):
     # hybrid mesh: leading axis maps across hosts (DCN), trailing within a
     # host's ICI domain; reduces to a plain all-device mesh single-process
@@ -123,17 +193,60 @@ def train(
     # tracing at all): trace steps [profile_steps) of the first epoch into
     # profile_dir, viewable with tensorboard/xprof.
     metrics_path = os.path.join(checkpoint_dir, "metrics.jsonl")
-    if jax.process_index() == 0 and start_epoch == 0:
+    if jax.process_index() == 0 and start_epoch == 0 and start_batch == 0:
         # fresh (non-resume) run: don't mix epochs with a prior run's
-        # lines; resume keeps appending to its own history
+        # lines; any resume — epoch- or step-granular — keeps appending
         os.makedirs(checkpoint_dir, exist_ok=True)
         open(metrics_path, "w").close()
+
+    def snapshot(epoch, losses, is_best=False, cursor_batch=None):
+        """One durable checkpoint; ``cursor_batch`` marks a mid-epoch
+        snapshot carrying the loader cursor for step-granular resume."""
+        if jax.process_index() != 0:
+            return  # multi-host: only process 0 writes checkpoints
+        cursor = None
+        if cursor_batch is not None:
+            cursor = {
+                "epoch": epoch,
+                "batch_index": cursor_batch,
+                "shuffle_seed": int(getattr(train_loader, "seed", 0)),
+                # float() is exact f32->f64, so a resumed epoch's mean
+                # equals the uninterrupted run's bit-for-bit
+                "epoch_losses": [float(l) for l in losses],
+            }
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        save_checkpoint(
+            os.path.join(checkpoint_dir, checkpoint_name),
+            CheckpointData(
+                config=config,
+                params=jax.device_get(state.params),
+                opt_state=jax.device_get(state.opt_state),
+                step=int(state.step),
+                epoch=epoch if cursor_batch is not None else epoch + 1,
+                train_loss=np.asarray(train_hist),
+                val_loss=np.asarray(val_hist),
+                best_val_loss=best_val,
+                train_fe=train_fe,
+                fe_finetune_blocks=fe_finetune_blocks,
+                cursor=cursor,
+            ),
+            is_best=is_best,
+            keep=keep_checkpoints,
+        )
+
     profiling = False
+    preempted = False
     for epoch in range(start_epoch, num_epochs):
         t0 = time.time()
         t_last = t0
-        losses = []
-        for i, dbatch in enumerate(_prefetch_device_batches(mesh, train_loader)):
+        skip = start_batch if epoch == start_epoch else 0
+        # a resumed epoch re-seeds its already-computed step losses so the
+        # epoch mean is over ALL its steps, not just the replayed tail
+        losses = list(start_epoch_losses or []) if skip else []
+        batches = _epoch_iter(train_loader, epoch, skip=skip)
+        for i, dbatch in enumerate(
+            _prefetch_device_batches(mesh, batches), start=skip
+        ):
             if profile_dir and epoch == start_epoch:
                 if i == profile_steps[0]:
                     jax.profiler.start_trace(profile_dir)
@@ -148,6 +261,8 @@ def train(
                     profiling = False
                     print(f"profile trace written to {profile_dir}", flush=True)
             state, loss = train_step(state, dbatch)
+            losses.append(loss)
+            faultinject.fire("step.boundary")
             if sanitizer.is_enabled():
                 # sanitized runs are diagnostic: pay a per-step D2H sync so
                 # a non-finite loss stops IMMEDIATELY with the per-stage
@@ -167,10 +282,26 @@ def train(
                     f"loss {loss_host:.6f} ({ms:.0f} ms/step)",
                     flush=True,
                 )
-            losses.append(loss)
+            want_preempt = preemption is not None and preemption.requested
+            if (
+                save_every_steps and (i + 1) % save_every_steps == 0
+            ) or want_preempt:
+                # mid-epoch durable snapshot with the loader cursor; the
+                # float() syncs are confined to snapshot boundaries
+                snapshot(epoch, losses, cursor_batch=i + 1)
+            if want_preempt:
+                print(
+                    f"preempted at epoch {epoch + 1} step {i + 1}: "
+                    "checkpoint written, exiting cleanly",
+                    flush=True,
+                )
+                preempted = True
+                break
         if profiling:  # epoch shorter than the profile window
             jax.profiler.stop_trace()
             profiling = False
+        if preempted:
+            break
         train_loss = float(np.mean([float(l) for l in losses])) if losses else 0.0
         train_hist.append(train_loss)
 
@@ -178,7 +309,9 @@ def train(
         if val_loader is not None:
             vlosses = [
                 float(eval_step(state.params, b))
-                for b in _prefetch_device_batches(mesh, val_loader)
+                for b in _prefetch_device_batches(
+                    mesh, _epoch_iter(val_loader, epoch)
+                )
             ]
             val_loss = float(np.mean(vlosses)) if vlosses else float("nan")
         val_hist.append(val_loss)
@@ -218,22 +351,11 @@ def train(
             plt.close(fig)
         except Exception as e:  # headless plotting must never kill training
             print(f"loss-curve plot skipped: {e}", flush=True)
-        save_checkpoint(
-            os.path.join(checkpoint_dir, checkpoint_name),
-            CheckpointData(
-                config=config,
-                params=jax.device_get(state.params),
-                opt_state=jax.device_get(state.opt_state),
-                step=int(state.step),
-                epoch=epoch + 1,
-                train_loss=np.asarray(train_hist),
-                val_loss=np.asarray(val_hist),
-                best_val_loss=best_val,
-                train_fe=train_fe,
-                fe_finetune_blocks=fe_finetune_blocks,
-            ),
-            is_best=is_best,
-        )
+        snapshot(epoch, losses, is_best=is_best)
     if sanitizer.is_enabled():
         print(sanitizer.report_text(), flush=True)
-    return state, {"train_loss": train_hist, "val_loss": val_hist}
+    return state, {
+        "train_loss": train_hist,
+        "val_loss": val_hist,
+        "preempted": preempted,
+    }

@@ -19,7 +19,8 @@ import jax
 from ncnet_tpu.data.loader import DataLoader
 from ncnet_tpu.data.pairs import ImagePairDataset, SyntheticPairDataset
 from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
-from ncnet_tpu.train.checkpoint import load_checkpoint
+from ncnet_tpu.resilience.signals import PreemptionGuard
+from ncnet_tpu.train.checkpoint import load_latest_valid
 from ncnet_tpu.train.loop import train
 
 
@@ -70,6 +71,24 @@ def main():
     p.add_argument("--result_model_dir", type=str, default="trained_models")
     p.add_argument("--result_model_fn", type=str, default="ncnet_tpu.msgpack")
     p.add_argument("--num_workers", type=int, default=4)
+    p.add_argument("--save-every-steps", type=int, default=0, dest="save_every_steps",
+                   help="also checkpoint every N optimizer steps (durable, "
+                        "with a mid-epoch resume cursor); 0 = epoch "
+                        "boundaries only")
+    p.add_argument("--keep-checkpoints", type=int, default=3,
+                   dest="keep_checkpoints",
+                   help="rotating retention: keep the newest K step-tagged "
+                        "checkpoint copies for corrupt-file fallback "
+                        "(0 disables history)")
+    p.add_argument("--sample-retries", type=int, default=2,
+                   dest="sample_retries",
+                   help="extra per-sample load attempts (exponential "
+                        "backoff) before a sample counts as corrupt")
+    p.add_argument("--skip-budget", type=int, default=0, dest="skip_budget",
+                   help="total corrupt samples the loaders may skip (each "
+                        "substituted by the next loadable index, "
+                        "shape-preserving) before failing loudly; 0 = "
+                        "fail on the first bad sample")
     p.add_argument("--device_normalize", action="store_true",
                    help="ship training images as uint8 and ImageNet-"
                         "normalize on device (4x less H2D traffic; "
@@ -175,6 +194,7 @@ def main():
         )
 
     start_epoch, start_step, opt_state, best_val = 0, 0, None, None
+    start_batch, start_epoch_losses = 0, None
     train_hist = val_hist = None
     if args.checkpoint and args.checkpoint.endswith((".pth.tar", ".pth")):
         import torch
@@ -206,7 +226,11 @@ def main():
         print(f"initialized from reference checkpoint {args.checkpoint} "
               "(weights-only: torch optimizer state is not portable)")
     elif args.checkpoint:
-        ck = load_checkpoint(args.checkpoint)
+        # walks back past a torn/corrupt latest file to the newest valid
+        # checkpoint (main file, then its .step<N> rotation history)
+        ck, used_path = load_latest_valid(args.checkpoint)
+        if used_path != args.checkpoint:
+            print(f"latest checkpoint invalid; fell back to {used_path}")
         config, params = ck.config, ck.params
         if args.conv4d_impl:  # explicit flag overrides the checkpoint's
             config = config.replace(conv4d_impl=args.conv4d_impl)
@@ -242,8 +266,23 @@ def main():
         opt_state = ck.opt_state  # raw state dict; train() restores into shape
         best_val = ck.best_val_loss
         train_hist, val_hist = ck.train_loss, ck.val_loss
-        print(f"resuming from {args.checkpoint} at epoch {start_epoch} "
-              f"(step {start_step})")
+        if ck.cursor:
+            # mid-epoch snapshot: resume at the exact step, replaying the
+            # same shuffle (the cursor pins the loader seed)
+            start_epoch = int(ck.cursor["epoch"])
+            start_batch = int(ck.cursor["batch_index"])
+            start_epoch_losses = ck.cursor["epoch_losses"]
+            if int(ck.cursor["shuffle_seed"]) != args.seed:
+                print(
+                    f"WARNING: --seed {args.seed} differs from the "
+                    f"checkpoint's loader seed {ck.cursor['shuffle_seed']}; "
+                    "the resumed epoch will replay a DIFFERENT shuffle",
+                    flush=True,
+                )
+        print(f"resuming from {used_path} at epoch {start_epoch} "
+              f"(step {start_step}"
+              + (f", batch {start_batch}" if start_batch else "")
+              + ")")
         print(f"  config: {config}")
     else:
         config = ImMatchNetConfig(
@@ -305,36 +344,49 @@ def main():
     # --batch_size is GLOBAL; each host loads its 1/n_hosts slice and the
     # global array is assembled in shard_batch (parallel/mesh.py)
     local_bs = args.batch_size // n_hosts
-    train_loader = DataLoader(
+    # context-managed loaders + the preemption guard: a SIGTERM (cloud TPU
+    # preemption notice) or Ctrl-C checkpoints once at the next step
+    # boundary and exits cleanly, with the worker pools shut down on every
+    # path (train() also closes the loaders from its own finally)
+    with PreemptionGuard() as guard, DataLoader(
         train_ds, local_bs, shuffle=True, seed=args.seed,
         num_workers=args.num_workers, drop_last=True,
         host_id=host_id, n_hosts=n_hosts, backend=args.loader_backend,
-    )
-    val_loader = DataLoader(
+        sample_retries=args.sample_retries, skip_budget=args.skip_budget,
+    ) as train_loader, DataLoader(
         val_ds, local_bs, shuffle=False,
         num_workers=args.num_workers, drop_last=True,
         host_id=host_id, n_hosts=n_hosts, backend=args.loader_backend,
-    )
-
-    train(
-        config,
-        params,
-        train_loader,
-        val_loader,
-        num_epochs=args.num_epochs,
-        learning_rate=args.lr,
-        train_fe=args.train_fe,
-        fe_finetune_blocks=args.fe_finetune_params,
-        checkpoint_dir=args.result_model_dir,
-        checkpoint_name=args.result_model_fn,
-        start_epoch=start_epoch,
-        start_step=start_step,
-        opt_state=opt_state,
-        initial_best_val=best_val,
-        initial_train_hist=train_hist,
-        initial_val_hist=val_hist,
-        profile_dir=args.profile_dir or None,
-    )
+        sample_retries=args.sample_retries, skip_budget=args.skip_budget,
+    ) as val_loader:
+        _, history = train(
+            config,
+            params,
+            train_loader,
+            val_loader,
+            num_epochs=args.num_epochs,
+            learning_rate=args.lr,
+            train_fe=args.train_fe,
+            fe_finetune_blocks=args.fe_finetune_params,
+            checkpoint_dir=args.result_model_dir,
+            checkpoint_name=args.result_model_fn,
+            start_epoch=start_epoch,
+            start_step=start_step,
+            start_batch=start_batch,
+            start_epoch_losses=start_epoch_losses,
+            opt_state=opt_state,
+            initial_best_val=best_val,
+            initial_train_hist=train_hist,
+            initial_val_hist=val_hist,
+            profile_dir=args.profile_dir or None,
+            save_every_steps=args.save_every_steps,
+            keep_checkpoints=args.keep_checkpoints,
+            preemption=guard,
+        )
+    if history.get("preempted"):
+        print("exiting after preemption checkpoint (resume with "
+              f"--checkpoint {os.path.join(args.result_model_dir, args.result_model_fn)})",
+              flush=True)
 
 
 if __name__ == "__main__":

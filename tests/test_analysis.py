@@ -299,6 +299,57 @@ def test_traced_branch_clean():
     assert findings_for(CLEAN_BRANCH, only="traced-python-branch") == []
 
 
+# --- non-atomic-artifact-write ----------------------------------------------
+
+
+BAD_ARTIFACT_WRITE = """
+from flax import serialization
+
+def save_checkpoint(path, payload):
+    with open(path, "wb") as f:
+        f.write(serialization.msgpack_serialize(payload))
+"""
+
+BAD_ARTIFACT_WRITE_NAME = """
+def dump(metrics_path, blob):
+    with open(metrics_path, mode="wb") as f:
+        f.write(blob)
+"""
+
+CLEAN_ARTIFACT_WRITE = """
+from ncnet_tpu.resilience.durable import durable_write_bytes
+
+def save_checkpoint(path, blob):
+    durable_write_bytes(path, blob)
+
+def write_png(path, encoded):
+    # non-resume-critical binary output: out of the rule's scope
+    with open(path, "wb") as f:
+        f.write(encoded)
+
+def read_checkpoint(path):
+    with open(path, "rb") as f:
+        return f.read()
+"""
+
+
+def test_non_atomic_artifact_write_bad():
+    fs = findings_for(BAD_ARTIFACT_WRITE, only="non-atomic-artifact-write")
+    assert len(fs) == 1 and fs[0].line == 5
+    assert "durable_write_bytes" in fs[0].message
+    fs = findings_for(BAD_ARTIFACT_WRITE_NAME, only="non-atomic-artifact-write")
+    assert len(fs) == 1
+
+
+def test_non_atomic_artifact_write_clean():
+    assert findings_for(CLEAN_ARTIFACT_WRITE,
+                        only="non-atomic-artifact-write") == []
+
+
+def test_non_atomic_artifact_write_exempts_tests():
+    assert findings_for(BAD_ARTIFACT_WRITE, path="tests/test_ck.py") == []
+
+
 # --- mutable-default-arg ----------------------------------------------------
 
 

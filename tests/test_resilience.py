@@ -1,0 +1,438 @@
+"""Preemption-safety proofs (ncnet_tpu.resilience + train/checkpoint/loop).
+
+The point of this file is that recovery is DEMONSTRATED, not asserted:
+faults are injected at the named crash points (checkpoint mid-write, step
+boundaries, worker batch construction) and the resumed run must match the
+uninterrupted run bitwise on params — plus unit coverage of the durable
+write/verify/rotate/walk-back primitives, the fault registry itself, and
+the SIGTERM-to-clean-exit guard.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from ncnet_tpu.data.loader import DataLoader
+from ncnet_tpu.data.pairs import SyntheticPairDataset
+from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+from ncnet_tpu.resilience import durable, faultinject
+from ncnet_tpu.resilience.signals import PreemptionGuard
+from ncnet_tpu.train.checkpoint import (
+    CheckpointData,
+    load_checkpoint,
+    load_latest_valid,
+    save_checkpoint,
+)
+from ncnet_tpu.train.loop import train
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = ImMatchNetConfig(ncons_kernel_sizes=(3,), ncons_channels=(1,))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    faultinject.clear()
+    yield
+    faultinject.clear()
+
+
+def tiny_ckpt(step=1, fill=0.0):
+    return CheckpointData(
+        config=CFG,
+        params={"w": np.full((64,), fill, np.float32)},
+        step=step,
+    )
+
+
+# --- durable primitives -----------------------------------------------------
+
+
+def test_durable_write_and_verify(tmp_path):
+    path = str(tmp_path / "artifact.bin")
+    durable.durable_write_bytes(path, b"payload-bytes")
+    assert durable.verify_digest(path) is True
+    assert durable.read_verified_bytes(path) == b"payload-bytes"
+    # bitrot: flip a byte -> detected, not parsed
+    blob = bytearray(open(path, "rb").read())
+    blob[0] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+    assert durable.verify_digest(path) is False
+    with pytest.raises(durable.IntegrityError):
+        durable.read_verified_bytes(path)
+
+
+def test_durable_legacy_file_without_sidecar(tmp_path):
+    """Pre-durability files (no sidecar) still load; verification is just
+    unknown rather than failed."""
+    path = str(tmp_path / "legacy.bin")
+    with open(path, "wb") as f:
+        f.write(b"old-format")
+    assert durable.verify_digest(path) is None
+    assert durable.read_verified_bytes(path) == b"old-format"
+
+
+def test_retention_rotates_and_prunes(tmp_path):
+    path = str(tmp_path / "ck.msgpack")
+    for step in (1, 2, 3):
+        save_checkpoint(path, tiny_ckpt(step=step, fill=float(step)), keep=2)
+    names = sorted(os.listdir(tmp_path))
+    assert os.path.basename(durable.step_path(path, 2)) in names
+    assert os.path.basename(durable.step_path(path, 3)) in names
+    assert os.path.basename(durable.step_path(path, 1)) not in names
+    # newest-first walk order: primary, then history by descending step
+    assert durable.candidates(path) == [
+        path, durable.step_path(path, 3), durable.step_path(path, 2)
+    ]
+
+
+def test_load_latest_valid_walks_past_truncated(tmp_path):
+    """The acceptance-criteria case: a deliberately truncated latest file
+    must cost one fallback, not the run."""
+    path = str(tmp_path / "ck.msgpack")
+    save_checkpoint(path, tiny_ckpt(step=1, fill=1.0), keep=3)
+    save_checkpoint(path, tiny_ckpt(step=2, fill=2.0), keep=3)
+    # tear the primary the way a mid-write kill of a NON-durable writer
+    # would have: a half-written NEW file under the checkpoint name (the
+    # step-2 history hardlink keeps the intact old inode)
+    half = open(path, "rb").read()[: os.path.getsize(path) // 2]
+    os.remove(path)
+    with open(path, "wb") as f:
+        f.write(half)
+    ck, used = load_latest_valid(path)
+    assert used == durable.step_path(path, 2)
+    assert int(ck.step) == 2
+    np.testing.assert_array_equal(ck.params["w"], np.full((64,), 2.0, np.float32))
+
+    # everything torn -> loud FileNotFoundError, not a silent fresh start
+    for cand in durable.candidates(path):
+        with open(cand, "r+b") as f:
+            f.truncate(4)
+    with pytest.raises(FileNotFoundError):
+        load_latest_valid(path)
+
+
+def test_corrupt_bytes_fault_is_detected_at_load(tmp_path):
+    """`checkpoint.bytes=corrupt` models bitrot between digest and disk:
+    the sidecar records the intended bytes, so load must refuse."""
+    path = str(tmp_path / "ck.msgpack")
+    save_checkpoint(path, tiny_ckpt(step=1, fill=1.0), keep=3)
+    faultinject.configure("checkpoint.bytes=corrupt@1")
+    save_checkpoint(path, tiny_ckpt(step=2, fill=2.0), keep=3)
+    assert durable.verify_digest(path) is False
+    with pytest.raises(durable.IntegrityError):
+        load_checkpoint(path)
+    ck, used = load_latest_valid(path)
+    # the corrupt step-2 bytes were also hardlinked into history; recovery
+    # lands on the intact step-1 save
+    assert int(ck.step) == 1 and used == durable.step_path(path, 1)
+
+
+def test_crash_during_write_leaves_previous_checkpoint(tmp_path):
+    """In-process crash (exception unwind) at both kill windows: the
+    torn temp file never replaces the good checkpoint."""
+    path = str(tmp_path / "ck.msgpack")
+    save_checkpoint(path, tiny_ckpt(step=1, fill=1.0))
+    for point in ("checkpoint.write", "checkpoint.rename"):
+        faultinject.clear()
+        faultinject.inject(point, "crash", at=1)
+        with pytest.raises(faultinject.InjectedFault):
+            save_checkpoint(path, tiny_ckpt(step=2, fill=2.0))
+        assert durable.verify_digest(path) is True
+        ck = load_checkpoint(path)
+        assert int(ck.step) == 1, f"crash at {point} clobbered the checkpoint"
+
+
+def test_hard_kill_mid_checkpoint_write(tmp_path):
+    """A true preemption (os._exit, no cleanup) landing mid-write of the
+    checkpoint temp file: the previous checkpoint must stay loadable."""
+    path = str(tmp_path / "ck.msgpack")
+    script = f"""
+import sys
+sys.path.insert(0, {REPO!r})
+import numpy as np
+from ncnet_tpu.models.immatchnet import ImMatchNetConfig
+from ncnet_tpu.resilience import faultinject
+from ncnet_tpu.train.checkpoint import CheckpointData, save_checkpoint
+
+cfg = ImMatchNetConfig(ncons_kernel_sizes=(3,), ncons_channels=(1,))
+def ck(step, fill):
+    return CheckpointData(
+        config=cfg, params={{"w": np.full((64,), fill, np.float32)}}, step=step
+    )
+
+path = {path!r}
+save_checkpoint(path, ck(1, 1.0))
+faultinject.configure("checkpoint.write=kill@1")
+save_checkpoint(path, ck(2, 2.0))  # dies half-written, pre-rename
+raise SystemExit("unreachable: the kill fault did not fire")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=300, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 137, proc.stderr
+    ck, used = load_latest_valid(path)
+    assert used == path and int(ck.step) == 1
+    np.testing.assert_array_equal(ck.params["w"], np.full((64,), 1.0, np.float32))
+    # the torn temp file is on disk (proof the kill landed mid-write) but
+    # invisible to recovery
+    tmps = [n for n in os.listdir(tmp_path) if ".tmp." in n]
+    assert tmps, "kill fault should have left a torn temp file behind"
+
+
+def test_best_copy_is_durable_and_verified(tmp_path):
+    """The satellite fix: best_ goes through temp+rename+digest, not
+    shutil.copyfile."""
+    path = str(tmp_path / "ck.msgpack")
+    save_checkpoint(path, tiny_ckpt(step=1, fill=3.0), is_best=True)
+    best = str(tmp_path / "best_ck.msgpack")
+    assert durable.verify_digest(best) is True
+    ck = load_checkpoint(best)
+    np.testing.assert_array_equal(ck.params["w"], np.full((64,), 3.0, np.float32))
+
+
+def test_cursor_roundtrip_and_legacy_none(tmp_path):
+    path = str(tmp_path / "ck.msgpack")
+    cursor = {
+        "epoch": 2, "batch_index": 5, "shuffle_seed": 7,
+        "epoch_losses": [0.5, 0.25, 0.125],
+    }
+    data = tiny_ckpt(step=13)
+    data.cursor = cursor
+    save_checkpoint(path, data)
+    loaded = load_checkpoint(path)
+    assert loaded.cursor == cursor
+    # epoch-boundary checkpoints carry no cursor
+    save_checkpoint(path, tiny_ckpt(step=14))
+    assert load_checkpoint(path).cursor is None
+
+
+# --- fault registry ---------------------------------------------------------
+
+
+def test_faultinject_disabled_is_identity():
+    faultinject.clear()
+    assert not faultinject.is_enabled()
+    blob = b"untouched"
+    assert faultinject.fire("checkpoint.bytes", blob) is blob
+    assert faultinject.fire("step.boundary") is None
+
+
+def test_faultinject_at_counts_hits():
+    faultinject.configure("step.boundary=crash@3")
+    faultinject.fire("step.boundary")
+    faultinject.fire("step.boundary")
+    with pytest.raises(faultinject.InjectedFault):
+        faultinject.fire("step.boundary")
+    # past its hit index the fault stays quiet
+    faultinject.fire("step.boundary")
+
+
+def test_faultinject_spec_errors():
+    with pytest.raises(ValueError):
+        faultinject.configure("step.boundary")
+    with pytest.raises(ValueError):
+        faultinject.inject("p", "explode")
+
+
+def test_faultinject_corrupt_changes_bytes():
+    faultinject.inject("checkpoint.bytes", "corrupt")
+    blob = bytes(range(64))
+    out = faultinject.fire("checkpoint.bytes", blob)
+    assert out != blob and len(out) == len(blob)
+
+
+# --- preemption guard -------------------------------------------------------
+
+
+def test_preemption_guard_sets_flag_and_restores_handler():
+    before = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard(signals=(signal.SIGTERM,)) as guard:
+        assert not guard.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert guard.requested  # delivered synchronously in the main thread
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_preemption_guard_second_signal_falls_through():
+    hits = []
+    old = signal.signal(signal.SIGTERM, lambda *a: hits.append(a))
+    try:
+        with PreemptionGuard(signals=(signal.SIGTERM,)) as guard:
+            guard._handle(signal.SIGTERM, None)
+            assert guard.requested and not hits
+            guard._handle(signal.SIGTERM, None)  # impatient operator
+        assert hits, "second signal must reach the previous handler"
+    finally:
+        signal.signal(signal.SIGTERM, old)
+
+
+# --- end-to-end: kill-and-resume equals uninterrupted -----------------------
+
+N_PAIRS, BATCH, EPOCHS, SIZE = 8, 2, 2, 32
+STEPS_PER_EPOCH = N_PAIRS // BATCH
+
+
+def _loader(**kw):
+    ds = SyntheticPairDataset(n=N_PAIRS, output_size=(SIZE, SIZE), seed=11)
+    kw.setdefault("num_workers", 1)
+    kw.setdefault("prefetch", 0)
+    return DataLoader(ds, BATCH, shuffle=True, seed=5, drop_last=True, **kw)
+
+
+def _run(ckdir, **train_kw):
+    params = init_immatchnet(jax.random.PRNGKey(0), CFG)
+    kw = dict(
+        num_epochs=EPOCHS, checkpoint_dir=str(ckdir), data_parallel=False,
+        log_every=100, save_every_steps=2, keep_checkpoints=4,
+    )
+    kw.update(train_kw)
+    return train(CFG, kw.pop("params", params), _loader(), None, **kw)
+
+
+def _resume(ckdir, **train_kw):
+    ck, used = load_latest_valid(os.path.join(str(ckdir), "ncnet_tpu.msgpack"))
+    kw = dict(
+        params=ck.params,
+        opt_state=ck.opt_state,
+        start_epoch=ck.epoch,
+        start_step=ck.step,
+        initial_best_val=ck.best_val_loss,
+        initial_train_hist=ck.train_loss,
+        initial_val_hist=ck.val_loss,
+    )
+    if ck.cursor:
+        kw["start_epoch"] = ck.cursor["epoch"]
+        kw["start_batch"] = ck.cursor["batch_index"]
+        kw["start_epoch_losses"] = ck.cursor["epoch_losses"]
+    kw.update(train_kw)
+    return _run(ckdir, **kw), ck
+
+
+def _final_state(ckdir):
+    ck = load_checkpoint(os.path.join(str(ckdir), "ncnet_tpu.msgpack"))
+    lines = [
+        json.loads(l)
+        for l in open(os.path.join(str(ckdir), "metrics.jsonl"))
+    ]
+    return ck, lines
+
+
+def _assert_bitwise_equal(ck_a, ck_b):
+    flat_a, _ = jax.tree_util.tree_flatten_with_path(ck_a.params)
+    flat_b, _ = jax.tree_util.tree_flatten_with_path(ck_b.params)
+    assert len(flat_a) == len(flat_b)
+    for (path_a, leaf_a), (_, leaf_b) in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(
+            np.asarray(leaf_a), np.asarray(leaf_b),
+            err_msg=f"params differ at {jax.tree_util.keystr(path_a)}",
+        )
+    for a, b in zip(jax.tree.leaves(ck_a.opt_state), jax.tree.leaves(ck_b.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(ck_a.step) == int(ck_b.step)
+    np.testing.assert_array_equal(
+        np.asarray(ck_a.train_loss), np.asarray(ck_b.train_loss)
+    )
+
+
+def _assert_metrics_tails_match(lines_a, lines_b):
+    """Identical metrics.jsonl tails, modulo wall-clock epoch_seconds."""
+    strip = lambda l: {k: v for k, v in l.items() if k != "epoch_seconds"}
+    assert [strip(l) for l in lines_a] == [strip(l) for l in lines_b]
+
+
+@pytest.fixture(scope="module")
+def uninterrupted(tmp_path_factory):
+    ckdir = tmp_path_factory.mktemp("uninterrupted")
+    _run(ckdir)
+    return _final_state(ckdir)
+
+
+def test_resume_after_crash_at_step_boundary(tmp_path, uninterrupted):
+    """Kill at a mid-epoch step boundary; the resumed run must be
+    indistinguishable — bitwise on params/opt_state, identical metrics."""
+    crash_hit = STEPS_PER_EPOCH + 3  # epoch 1, step 3: past a step-2 snapshot
+    faultinject.inject("step.boundary", "crash", at=crash_hit)
+    with pytest.raises(faultinject.InjectedFault):
+        _run(tmp_path)
+    faultinject.clear()
+
+    (_, history), ck_at_resume = _resume(tmp_path)
+    assert ck_at_resume.cursor is not None, "expected a mid-epoch snapshot"
+    assert ck_at_resume.cursor["batch_index"] == 2
+    assert not history["preempted"]
+
+    ck_a, lines_a = uninterrupted
+    ck_b, lines_b = _final_state(tmp_path)
+    _assert_bitwise_equal(ck_a, ck_b)
+    _assert_metrics_tails_match(lines_a, lines_b)
+
+
+def test_resume_after_crash_in_worker_batch_construction(tmp_path, uninterrupted):
+    """Kill during batch construction inside a loader worker; training dies
+    loudly, resume from the last snapshot matches bitwise."""
+    faultinject.inject("data.batch", "crash", at=STEPS_PER_EPOCH + 3)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        _run(tmp_path)
+    faultinject.clear()
+
+    _resume(tmp_path)
+    ck_a, lines_a = uninterrupted
+    ck_b, lines_b = _final_state(tmp_path)
+    _assert_bitwise_equal(ck_a, ck_b)
+    _assert_metrics_tails_match(lines_a, lines_b)
+
+
+def test_preemption_checkpoints_once_and_resumes(tmp_path, uninterrupted):
+    """SIGTERM-style preemption mid-epoch: one cursor checkpoint, clean
+    return, and the resumed run matches the uninterrupted one bitwise."""
+
+    class _Guard:
+        def __init__(self, after_steps):
+            self.after = after_steps
+            self.seen = 0
+
+        @property
+        def requested(self):
+            return self.seen >= self.after
+
+    guard = _Guard(after_steps=STEPS_PER_EPOCH + 1)  # epoch 1, step 1
+    real_fire = faultinject.fire
+
+    def counting_fire(point, data=None):
+        if point == "step.boundary":
+            guard.seen += 1
+        return real_fire(point, data)
+
+    faultinject_fire_patch = pytest.MonkeyPatch()
+    faultinject_fire_patch.setattr(
+        "ncnet_tpu.train.loop.faultinject.fire", counting_fire
+    )
+    try:
+        _, history = _run(tmp_path, preemption=guard)
+    finally:
+        faultinject_fire_patch.undo()
+    assert history["preempted"]
+
+    ck_mid = load_checkpoint(os.path.join(str(tmp_path), "ncnet_tpu.msgpack"))
+    assert ck_mid.cursor == {
+        "epoch": 1, "batch_index": 1, "shuffle_seed": 5,
+        "epoch_losses": ck_mid.cursor["epoch_losses"],
+    }
+    assert len(ck_mid.cursor["epoch_losses"]) == 1
+
+    (_, history2), _ = _resume(tmp_path)
+    assert not history2["preempted"]
+    ck_a, lines_a = uninterrupted
+    ck_b, lines_b = _final_state(tmp_path)
+    _assert_bitwise_equal(ck_a, ck_b)
+    _assert_metrics_tails_match(lines_a, lines_b)
