@@ -1,0 +1,28 @@
+"""Epoch-persistent feature cache for frozen-trunk training and eval.
+
+With the backbone frozen (the reference default), its forward pass over a
+fixed dataset is deterministic and parameter-constant — the fastest trunk
+pass is the one that never runs. This package extracts trunk features
+ONCE into a durable digest-guarded on-disk store (`store`), fills it
+lazily or via ``scripts/extract_features.py`` (`extract`), and the
+training stack consumes it through ``ncnet_tpu.data.features_loader``
+plus the ``from_features`` modes of ``train/loss.py`` and
+``train/step.py``.
+"""
+
+from ncnet_tpu.features.extract import make_batch_extractor, populate_store
+from ncnet_tpu.features.store import (
+    FeatureCacheMismatch,
+    FeatureStore,
+    feature_dtype_name,
+    trunk_digest,
+)
+
+__all__ = [
+    "FeatureCacheMismatch",
+    "FeatureStore",
+    "feature_dtype_name",
+    "make_batch_extractor",
+    "populate_store",
+    "trunk_digest",
+]

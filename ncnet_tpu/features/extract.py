@@ -1,0 +1,81 @@
+"""Populate a :class:`FeatureStore` by running the frozen trunk once.
+
+Used by the ``scripts/extract_features.py`` CLI and by the lazy
+fill-on-first-epoch path in ``scripts/train.py`` (--feature-cache): only
+the MISSING shards are extracted, so an interrupted extraction resumes
+where it stopped and a populated cache costs one directory scan.
+"""
+
+import time
+
+import numpy as np
+
+from ncnet_tpu.models.immatchnet import extract_features
+
+
+def make_batch_extractor(params, config):
+    """Jitted ``[b, h, w, 3] image batch -> feature batch`` for the
+    config's trunk; uint8 batches are ImageNet-normalized on device, the
+    same dtype keying as the training loss (train/loss.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    def _extract(images):
+        if images.dtype == jnp.uint8:
+            from ncnet_tpu.ops.image import imagenet_normalize
+
+            images = imagenet_normalize(images.astype(jnp.float32))
+        return extract_features(params, config, images)
+
+    return jax.jit(_extract)
+
+
+def populate_store(store, params, config, dataset, batch_size=8,
+                   log_every=0):
+    """Extract and durably write every missing shard; returns the count
+    of pairs extracted (0 when the store was already complete).
+
+    Source and target images of each chunk run as ONE double-batch trunk
+    application, and the final partial chunk is padded by repetition so
+    the jitted extractor compiles exactly once per store.
+    """
+    if len(dataset) != store.num_items:
+        # belt alongside the manifest's num_items check: a store populated
+        # from a different dataset must not be silently topped up
+        raise ValueError(
+            f"dataset has {len(dataset)} items but the store manifest "
+            f"records {store.num_items}"
+        )
+    missing = store.missing()
+    if not missing:
+        return 0
+    extractor = make_batch_extractor(params, config)
+    out_dtype = store.dtype
+    t0 = time.time()
+    done = 0
+    for lo in range(0, len(missing), batch_size):
+        group = missing[lo : lo + batch_size]
+        samples = [dataset[i] for i in group]
+        pad = batch_size - len(group)
+        if pad:
+            samples = samples + [samples[-1]] * pad
+        src = np.stack([s["source_image"] for s in samples])
+        tgt = np.stack([s["target_image"] for s in samples])
+        feats = np.asarray(extractor(np.concatenate([src, tgt], axis=0)))
+        if feats.dtype != out_dtype:
+            raise RuntimeError(
+                f"extractor produced {feats.dtype} but the store holds "
+                f"{out_dtype}; the config does not match the manifest"
+            )
+        feats_src, feats_tgt = feats[:batch_size], feats[batch_size:]
+        for j, idx in enumerate(group):
+            store.put(idx, feats_src[j], feats_tgt[j])
+        done += len(group)
+        if log_every and (done // batch_size) % log_every == 0:
+            rate = done / max(time.time() - t0, 1e-9)
+            print(
+                f"[features] {done}/{len(missing)} pairs extracted "
+                f"({rate:.1f} pairs/s)",
+                flush=True,
+            )
+    return done

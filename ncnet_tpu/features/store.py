@@ -1,0 +1,263 @@
+"""Durable on-disk store of precomputed trunk features.
+
+The default training config freezes the backbone (train/step.py), so the
+ResNet-101 forward over a fixed dataset is a parameter-constant
+computation — yet the reference re-runs it on every image at every step
+of every epoch. This store makes the trunk pass a one-time cost: one
+shard per image (source and target of each pair are separate shards),
+bf16 or f32, written with the ``resilience.durable`` discipline
+(temp + fsync + atomic rename + a ``<path>.sha256`` sidecar verified at
+read), so a preemption mid-extraction never leaves a torn shard and
+bitrot is detected instead of silently training on garbage features.
+
+Staleness is the failure mode that matters: features extracted by a
+DIFFERENT trunk (other weights, other backbone, other image size, other
+dtype, centering or normalization toggled) correlate like noise and
+training "works" while learning nothing. The manifest therefore records
+a digest over (trunk params bytes, cnn name, image size, feature dtype,
+normalize/center flags); opening a store with a non-matching digest
+raises :class:`FeatureCacheMismatch` — a stale cache is rejected, never
+silently reused.
+
+Disk math (PF-Pascal train, 400x400 resnet101): 25x25x1024 features are
+1.28 MB/image in bf16; ~2940 pairs x 2 images ~= 7.6 GB (2x in f32).
+"""
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+from ncnet_tpu.resilience import durable
+
+MANIFEST_NAME = "manifest.json"
+STORE_VERSION = 1
+
+#: dtypes a store may hold; bf16 numpy arrays come from ml_dtypes (a jax
+#: dependency), so shards round-trip bit-exactly without torch/jax imports
+_DTYPE_NAMES = ("float32", "bfloat16")
+
+
+class FeatureCacheMismatch(RuntimeError):
+    """The cache on disk was extracted under a different trunk/config."""
+
+
+def np_dtype(name):
+    if name == "float32":
+        return np.dtype(np.float32)
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    raise ValueError(
+        f"unsupported feature dtype {name!r}; have {_DTYPE_NAMES}"
+    )
+
+
+def feature_dtype_name(config):
+    """The on-disk dtype a config's extraction produces (models/immatchnet
+    ``extract_features``: bf16 under ``half_precision``, else f32)."""
+    return "bfloat16" if config.half_precision else "float32"
+
+
+def trunk_digest(fe_params, config, image_size):
+    """Digest of everything that determines the extracted feature bytes.
+
+    Covers the trunk parameter VALUES (not just the architecture name —
+    re-extracting after loading different pretrained weights must miss)
+    plus the extraction-relevant config: cnn name, input image size,
+    feature dtype, and the normalize/center toggles that run inside
+    ``feature_extraction_apply``.
+    """
+    import jax
+    from flax import serialization
+
+    state = serialization.to_state_dict(
+        jax.tree.map(lambda x: np.asarray(x), fe_params)
+    )
+    h = hashlib.sha256(serialization.msgpack_serialize(state))
+    h.update(
+        json.dumps(
+            {
+                "cnn": config.feature_extraction_cnn,
+                "image_size": [int(s) for s in image_size],
+                "feature_dtype": feature_dtype_name(config),
+                "normalize_features": bool(config.normalize_features),
+                "center_features": bool(config.center_features),
+            },
+            sort_keys=True,
+        ).encode("ascii")
+    )
+    return h.hexdigest()
+
+
+def _encode_shard(arr, dtype_name):
+    """Self-describing shard bytes: a tiny JSON header (shape + dtype)
+    then the raw feature bytes. Exact non-multiple-of-stride image sizes
+    make the feature shape awkward to predict, so each shard carries its
+    own; uniformity across a store is enforced by `get` callers stacking."""
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype != np_dtype(dtype_name):
+        raise ValueError(
+            f"shard dtype {arr.dtype} does not match the store's "
+            f"{dtype_name!r}; extract with the matching config instead of "
+            "casting (a silent cast would hide config drift)"
+        )
+    head = json.dumps(
+        {"shape": list(arr.shape), "dtype": dtype_name}
+    ).encode("ascii")
+    return len(head).to_bytes(4, "little") + head + arr.tobytes()
+
+
+def _decode_shard(blob, dtype_name):
+    hlen = int.from_bytes(blob[:4], "little")
+    head = json.loads(blob[4 : 4 + hlen].decode("ascii"))
+    if head["dtype"] != dtype_name:
+        raise FeatureCacheMismatch(
+            f"shard dtype {head['dtype']!r} does not match the manifest's "
+            f"{dtype_name!r}"
+        )
+    arr = np.frombuffer(
+        blob, dtype=np_dtype(dtype_name), offset=4 + hlen
+    )
+    return arr.reshape(head["shape"])
+
+
+class FeatureStore:
+    """One directory of per-image feature shards plus a digest manifest.
+
+    Construct through `create` / `open_store` / `open_or_create`; the
+    manifest and every shard go through ``resilience.durable`` writes.
+    """
+
+    def __init__(self, root, manifest):
+        self.root = os.path.abspath(root)
+        self.manifest = manifest
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def create(cls, root, digest, config, image_size, num_items):
+        manifest = {
+            "version": STORE_VERSION,
+            "digest": str(digest),
+            "cnn": config.feature_extraction_cnn,
+            "image_size": [int(s) for s in image_size],
+            "feature_dtype": feature_dtype_name(config),
+            "normalize_features": bool(config.normalize_features),
+            "center_features": bool(config.center_features),
+            "num_items": int(num_items),
+        }
+        np_dtype(manifest["feature_dtype"])  # validates the name
+        durable.durable_write_bytes(
+            os.path.join(os.path.abspath(root), MANIFEST_NAME),
+            json.dumps(manifest, sort_keys=True, indent=1).encode("ascii"),
+        )
+        return cls(root, manifest)
+
+    @classmethod
+    def open_store(cls, root, expected_digest=None, num_items=None):
+        """Open an existing store, REJECTING digest / size mismatches.
+
+        Raises ``FileNotFoundError`` when there is no manifest,
+        :class:`FeatureCacheMismatch` when the manifest was written under a
+        different trunk/config digest or for a different dataset size, and
+        ``resilience.durable.IntegrityError`` on manifest corruption.
+        """
+        path = os.path.join(os.path.abspath(root), MANIFEST_NAME)
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"no feature-cache manifest at {path}")
+        manifest = json.loads(
+            durable.read_verified_bytes(path).decode("ascii")
+        )
+        if expected_digest is not None and manifest.get("digest") != str(
+            expected_digest
+        ):
+            raise FeatureCacheMismatch(
+                f"feature cache at {root} was extracted under digest "
+                f"{manifest.get('digest')!r}, but the current trunk/config "
+                f"digests to {expected_digest!r} (trunk weights, backbone, "
+                "image size, feature dtype, or normalize/center flags "
+                "changed). Re-extract into a fresh directory — training on "
+                "stale features would silently learn noise."
+            )
+        if num_items is not None and int(manifest.get("num_items", -1)) != int(
+            num_items
+        ):
+            raise FeatureCacheMismatch(
+                f"feature cache at {root} holds {manifest.get('num_items')} "
+                f"items but the dataset has {num_items}; the cache belongs "
+                "to a different dataset"
+            )
+        return cls(root, manifest)
+
+    @classmethod
+    def open_or_create(cls, root, digest, config, image_size, num_items):
+        """Open a matching store, or create an empty one when absent.
+
+        An EXISTING manifest with a different digest still raises — only
+        a missing manifest falls through to creation."""
+        try:
+            return cls.open_store(
+                root, expected_digest=digest, num_items=num_items
+            )
+        except FileNotFoundError:
+            return cls.create(root, digest, config, image_size, num_items)
+
+    # -- shard IO ------------------------------------------------------------
+
+    @property
+    def num_items(self):
+        return int(self.manifest["num_items"])
+
+    @property
+    def dtype(self):
+        return np_dtype(self.manifest["feature_dtype"])
+
+    def shard_path(self, idx, role):
+        if role not in ("source", "target"):
+            raise ValueError(f"unknown shard role {role!r}")
+        return os.path.join(self.root, f"{int(idx):08d}.{role}.feat")
+
+    def has(self, idx):
+        return all(
+            os.path.exists(self.shard_path(idx, r))
+            for r in ("source", "target")
+        )
+
+    def missing(self):
+        """Indices without both shards — the lazy-fill worklist."""
+        return [i for i in range(self.num_items) if not self.has(i)]
+
+    def complete(self):
+        return not self.missing()
+
+    def put(self, idx, source_features, target_features):
+        """Durably write one pair's feature shards (idempotent rewrite)."""
+        name = self.manifest["feature_dtype"]
+        for role, arr in (
+            ("source", source_features),
+            ("target", target_features),
+        ):
+            durable.durable_write_bytes(
+                self.shard_path(idx, role), _encode_shard(arr, name)
+            )
+
+    def get(self, idx):
+        """Read one pair's ``(source, target)`` features, digest-verified
+        (raises ``durable.IntegrityError`` on bitrot)."""
+        name = self.manifest["feature_dtype"]
+        return tuple(
+            _decode_shard(
+                durable.read_verified_bytes(self.shard_path(idx, role)), name
+            )
+            for role in ("source", "target")
+        )
+
+    def shard_nbytes(self, idx=0):
+        """On-disk payload size of one pair (both shards), for fit math."""
+        return sum(
+            os.path.getsize(self.shard_path(idx, r))
+            for r in ("source", "target")
+        )

@@ -39,15 +39,48 @@ from ncnet_tpu.train.step import (
 
 
 def _device_batch(mesh, batch):
-    sub = {
-        "source_image": batch["source_image"],
-        "target_image": batch["target_image"],
-    }
+    # image batches and cached-feature batches (data/features_loader.py)
+    # ride the same path; feature batches from a pinned loader are already
+    # device arrays, for which jnp.asarray is a no-op
+    keys = (
+        ("source_features", "target_features")
+        if "source_features" in batch
+        else ("source_image", "target_image")
+    )
+    sub = {k: batch[k] for k in keys}
     if mesh is not None:
         # host-local numpy goes straight to shard_batch (multi-host
         # assembles the global array from per-process slices)
         return shard_batch(mesh, sub)
     return {k: jnp.asarray(v) for k, v in sub.items()}
+
+
+class _LossLog:
+    """Per-epoch loss accumulator with an INCREMENTALLY-converted host
+    prefix: every device loss crosses D2H exactly once, no matter how
+    many times the host list is needed (mid-epoch cursor snapshots, the
+    log-line sync, the epoch mean). The previous code re-ran ``float(l)``
+    over the whole prefix at every snapshot — O(n^2) syncs per epoch."""
+
+    def __init__(self, seed_losses=None):
+        # seeded values (a resumed epoch's already-computed step losses)
+        # are host floats already; only appended device scalars transfer
+        self._host = [float(v) for v in (seed_losses or [])]
+        self._pending = []
+
+    def append(self, loss):
+        self._pending.append(loss)
+
+    def host(self):
+        """The full host-float list; converts only the unconverted tail
+        (and thereby syncs on the most recent step)."""
+        if self._pending:
+            self._host.extend(float(l) for l in self._pending)
+            self._pending.clear()
+        return self._host
+
+    def __len__(self):
+        return len(self._host) + len(self._pending)
 
 
 def _prefetch_device_batches(mesh, loader, size=2):
@@ -123,8 +156,15 @@ def train(
     save_every_steps=0,
     keep_checkpoints=3,
     preemption=None,
+    from_features=False,
 ):
     """Run the training loop; returns ``(state, history)``.
+
+    ``from_features=True`` consumes cached-trunk-feature batches
+    (``source_features``/``target_features``, e.g. from
+    `ncnet_tpu.data.features_loader.FeatureBatchLoader`) instead of image
+    batches — zero backbone ops per step; requires a fully frozen trunk
+    (raises otherwise, before any compilation).
 
     Resilience knobs: ``start_batch``/``start_epoch_losses`` resume
     mid-epoch from a checkpoint cursor; ``save_every_steps > 0`` writes a
@@ -142,6 +182,7 @@ def train(
             start_batch, start_epoch_losses, opt_state, initial_best_val,
             initial_train_hist, initial_val_hist, log_every, profile_dir,
             profile_steps, save_every_steps, keep_checkpoints, preemption,
+            from_features,
         )
     finally:
         _close_quietly(train_loader, val_loader)
@@ -153,8 +194,12 @@ def _train_impl(
     data_parallel, start_epoch, start_step, start_batch, start_epoch_losses,
     opt_state, initial_best_val, initial_train_hist, initial_val_hist,
     log_every, profile_dir, profile_steps, save_every_steps,
-    keep_checkpoints, preemption,
+    keep_checkpoints, preemption, from_features,
 ):
+    if from_features:
+        from ncnet_tpu.train.step import check_from_features_frozen
+
+        check_from_features_frozen(train_fe, fe_finetune_blocks)
     # hybrid mesh: leading axis maps across hosts (DCN), trailing within a
     # host's ICI domain; reduces to a plain all-device mesh single-process
     mesh = make_hybrid_mesh() if data_parallel and jax.device_count() > 1 else None
@@ -178,9 +223,10 @@ def _train_impl(
         state = state._replace(opt_state=replicate(mesh, state.opt_state))
 
     train_step = make_train_step(
-        config, optimizer, train_fe, fe_finetune_blocks=fe_finetune_blocks
+        config, optimizer, train_fe, fe_finetune_blocks=fe_finetune_blocks,
+        from_features=from_features,
     )
-    eval_step = make_eval_step(config)
+    eval_step = make_eval_step(config, from_features=from_features)
 
     best_val = float("inf") if initial_best_val is None else float(initial_best_val)
     # Resume continues the loss histories rather than restarting them (the
@@ -211,8 +257,11 @@ def _train_impl(
                 "batch_index": cursor_batch,
                 "shuffle_seed": int(getattr(train_loader, "seed", 0)),
                 # float() is exact f32->f64, so a resumed epoch's mean
-                # equals the uninterrupted run's bit-for-bit
-                "epoch_losses": [float(l) for l in losses],
+                # equals the uninterrupted run's bit-for-bit; the _LossLog
+                # converts incrementally — each loss crosses D2H once even
+                # across many snapshots (the old per-snapshot full re-
+                # conversion made mid-epoch saves O(n^2) in syncs)
+                "epoch_losses": list(losses.host()),
             }
         os.makedirs(checkpoint_dir, exist_ok=True)
         save_checkpoint(
@@ -242,7 +291,7 @@ def _train_impl(
         skip = start_batch if epoch == start_epoch else 0
         # a resumed epoch re-seeds its already-computed step losses so the
         # epoch mean is over ALL its steps, not just the replayed tail
-        losses = list(start_epoch_losses or []) if skip else []
+        losses = _LossLog(start_epoch_losses if skip else None)
         batches = _epoch_iter(train_loader, epoch, skip=skip)
         for i, dbatch in enumerate(
             _prefetch_device_batches(mesh, batches), start=skip
@@ -255,8 +304,8 @@ def _train_impl(
                     # D2H sync so the device finishes the profiled steps
                     # before the trace closes (block_until_ready does not
                     # block on the tunneled platform — see bench.py)
-                    if losses:
-                        float(losses[-1])
+                    if len(losses):
+                        losses.host()
                     jax.profiler.stop_trace()
                     profiling = False
                     print(f"profile trace written to {profile_dir}", flush=True)
@@ -269,11 +318,13 @@ def _train_impl(
                 # report + first non-finite stage, instead of averaging
                 # NaN into the epoch
                 sanitizer.check_finite_or_report(
-                    float(loss), context=f"epoch {epoch + 1} step {i + 1}"
+                    losses.host()[-1],
+                    context=f"epoch {epoch + 1} step {i + 1}",
                 )
             if (i + 1) % log_every == 0:
-                # the float() D2H sync makes the step timing honest
-                loss_host = float(loss)
+                # host() syncs on the just-appended loss, keeping the step
+                # timing honest without a second transfer of that loss
+                loss_host = losses.host()[-1]
                 now = time.time()
                 ms = (now - t_last) / log_every * 1e3
                 t_last = now
@@ -302,17 +353,21 @@ def _train_impl(
             profiling = False
         if preempted:
             break
-        train_loss = float(np.mean([float(l) for l in losses])) if losses else 0.0
+        train_loss = float(np.mean(losses.host())) if len(losses) else 0.0
         train_hist.append(train_loss)
 
         val_loss = float("nan")
         if val_loader is not None:
-            vlosses = [
-                float(eval_step(state.params, b))
+            # collect DEVICE scalars and convert after the loop: a float()
+            # inside it would force a D2H sync per batch, serializing the
+            # validation pass against _prefetch_device_batches' H2D overlap
+            vdev = [
+                eval_step(state.params, b)
                 for b in _prefetch_device_batches(
                     mesh, _epoch_iter(val_loader, epoch)
                 )
             ]
+            vlosses = [float(v) for v in vdev]
             val_loss = float(np.mean(vlosses)) if vlosses else float("nan")
         val_hist.append(val_loss)
         is_best = val_loss < best_val

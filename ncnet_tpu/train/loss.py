@@ -67,12 +67,6 @@ def weak_loss(params, config, batch, normalization="softmax"):
     the full batch of features BEFORE chunking, and all scores are
     per-sample means.
     """
-    if config.relocalization_k_size > 1:
-        raise ValueError(
-            "weak_loss does not support relocalization configs "
-            "(the reference trains with relocalization_k_size=0; "
-            "relocalization is an eval-time memory optimization)"
-        )
     src, tgt = batch["source_image"], batch["target_image"]
     if src.dtype == jnp.uint8 or tgt.dtype == jnp.uint8:
         # uint8 batches ship 4x less host->device traffic (the loader's
@@ -88,8 +82,41 @@ def weak_loss(params, config, batch, normalization="softmax"):
             tgt = imagenet_normalize(tgt.astype(jnp.float32))
     feat_a = extract_features(params, config, src)
     feat_b = extract_features(params, config, tgt)
+    return weak_loss_core(
+        params["neigh_consensus"], config, feat_a, feat_b, normalization
+    )
+
+
+def weak_loss_from_features(params, config, batch, normalization="softmax"):
+    """`weak_loss` from PRECOMPUTED trunk features — the cache-consuming
+    entry point (``ncnet_tpu.features``): ``batch`` carries
+    ``source_features``/``target_features`` ``[b, fh, fw, c]`` exactly as
+    `extract_features` would have produced them (same dtype, same
+    normalize/center flags — the feature-store manifest digest enforces
+    this), and the backbone never runs. Only valid for a FROZEN trunk:
+    with ``train_fe`` or ``fe_finetune_blocks > 0`` the cached features
+    go stale after the first optimizer step (train/step.py raises before
+    tracing ever gets here).
+    """
+    feat_a = sanitizer.tap("features", batch["source_features"])
+    feat_b = sanitizer.tap("features", batch["target_features"])
+    return weak_loss_core(
+        params["neigh_consensus"], config, feat_a, feat_b, normalization
+    )
+
+
+def weak_loss_core(nc_params, config, feat_a, feat_b, normalization="softmax"):
+    """The shared post-backbone loss: rolled-negative pairing, optional
+    chunking/remat, symmetric score difference. Identical math whether the
+    features come from the in-graph trunk (`weak_loss`) or from a cache
+    (`weak_loss_from_features`)."""
+    if config.relocalization_k_size > 1:
+        raise ValueError(
+            "weak_loss does not support relocalization configs "
+            "(the reference trains with relocalization_k_size=0; "
+            "relocalization is an eval-time memory optimization)"
+        )
     feat_a_neg = jnp.roll(feat_a, -1, axis=0)
-    nc_params = params["neigh_consensus"]
 
     def pair_scores(fa, fb, fan):
         corr_pos = match_pipeline(nc_params, config, fa, fb)

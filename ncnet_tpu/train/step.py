@@ -12,7 +12,7 @@ import jax
 import optax
 
 from ncnet_tpu.analysis import sanitizer
-from ncnet_tpu.train.loss import weak_loss
+from ncnet_tpu.train.loss import weak_loss, weak_loss_from_features
 
 
 class TrainState(NamedTuple):
@@ -116,22 +116,45 @@ def create_train_state(params, optimizer, train_fe=False, step=0,
     return TrainState(params=params, opt_state=opt_state, step=step)
 
 
+def check_from_features_frozen(train_fe, fe_finetune_blocks):
+    """The feature cache is only correct for a FULLY frozen trunk: any
+    trunk training makes the cached features stale after one optimizer
+    step — training would silently consume features of the PREVIOUS trunk
+    forever. Raised at step/loop construction, before any tracing."""
+    if train_fe or fe_finetune_blocks > 0:
+        raise ValueError(
+            "from_features (the feature cache) requires a fully frozen "
+            f"trunk, but train_fe={train_fe} and fe_finetune_blocks="
+            f"{fe_finetune_blocks}: the trunk would train while the loss "
+            "reads features extracted from its pre-training weights. "
+            "Drop --feature-cache or the finetune flags."
+        )
+
+
 def make_train_step(
     config, optimizer, train_fe=False, normalization="softmax", donate=True,
-    fe_finetune_blocks=0,
+    fe_finetune_blocks=0, from_features=False,
 ):
     """Returns ``step(state, batch) -> (state, loss)``, jit-compiled.
 
     ``batch`` is a dict with ``source_image``/``target_image`` ``[b,h,w,3]``
-    (ImageNet-normalized NHWC). Under a `jax.sharding.Mesh` with the batch
-    sharded over the data axis and params replicated, XLA inserts the
-    gradient all-reduce automatically; no hand-written collectives needed.
+    (ImageNet-normalized NHWC) — or, with ``from_features=True``,
+    ``source_features``/``target_features`` precomputed trunk features
+    (``ncnet_tpu.features``): the step then contains ZERO backbone ops.
+    ``from_features`` with a training trunk raises immediately (the cache
+    would be stale after one step). Under a `jax.sharding.Mesh` with the
+    batch sharded over the data axis and params replicated, XLA inserts
+    the gradient all-reduce automatically; no hand-written collectives
+    needed.
     """
+    if from_features:
+        check_from_features_frozen(train_fe, fe_finetune_blocks)
+    loss_impl = weak_loss_from_features if from_features else weak_loss
     cnn = config.feature_extraction_cnn
 
     def loss_fn(trainable, params, batch):
         merged = merge_trainable(params, trainable, cnn)
-        return weak_loss(merged, config, batch, normalization)
+        return loss_impl(merged, config, batch, normalization)
 
     def step_fn(state, batch):
         trainable = trainable_subset(
@@ -153,10 +176,14 @@ def make_train_step(
     return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
 
 
-def make_eval_step(config, normalization="softmax"):
-    """Validation loss on a batch (reference process_epoch('test'))."""
+def make_eval_step(config, normalization="softmax", from_features=False):
+    """Validation loss on a batch (reference process_epoch('test')).
+    ``from_features=True`` evaluates from cached trunk features
+    (``source_features``/``target_features`` batches) with zero backbone
+    ops — same math, the trunk forward simply never runs."""
+    loss_impl = weak_loss_from_features if from_features else weak_loss
 
     def eval_fn(params, batch):
-        return weak_loss(params, config, batch, normalization)
+        return loss_impl(params, config, batch, normalization)
 
     return jax.jit(eval_fn)

@@ -1,0 +1,36 @@
+"""JAX persistent compilation cache, one call to enable.
+
+The conv4d NC stack takes minute-scale XLA compiles (benchmarks/PERF.md);
+without a persistent cache every process pays them again. Enabling
+``jax_compilation_cache_dir`` makes compiles a once-per-machine cost:
+identical (program, flags, platform) lookups hit the disk cache across
+runs, restarts, and preemption resumes.
+
+Entry point for the ``--compile-cache`` flag of ``scripts/train.py`` and
+``bench.py``: call BEFORE the first jit tracing.
+"""
+
+import os
+
+DEFAULT_CACHE_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "ncnet_tpu", "xla"
+)
+
+
+def enable_compile_cache(cache_dir=None):
+    """Point JAX's persistent compilation cache at ``cache_dir`` (default
+    ``~/.cache/ncnet_tpu/xla``); returns the directory used, or ``None``
+    when ``cache_dir`` is an empty/'none' sentinel (explicitly disabled).
+
+    The min-compile-time threshold is lowered to 1 s so the many small
+    per-shape entry points cache too, not just the big NC stack.
+    """
+    if cache_dir is not None and str(cache_dir).lower() in ("", "none", "off"):
+        return None
+    import jax
+
+    cache_dir = os.path.abspath(cache_dir or DEFAULT_CACHE_DIR)
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return cache_dir

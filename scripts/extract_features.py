@@ -1,0 +1,163 @@
+"""Populate a frozen-trunk feature cache (ncnet_tpu.features) from a
+pair dataset — the one-time backbone pass that `--feature-cache` training
+then never re-runs.
+
+Writes one durable digest-guarded store per split under
+``<--feature-cache>/<split>`` (the layout ``scripts/train.py
+--feature-cache DIR`` consumes). Idempotent: only missing shards are
+extracted, so an interrupted extraction resumes where it stopped and a
+complete cache is a no-op directory scan.
+
+Example (PF-Pascal paper config):
+  python scripts/extract_features.py --feature-cache features/pf-pascal \
+      --dataset_image_path datasets/pf-pascal \
+      --dataset_csv_path datasets/pf-pascal/image_pairs \
+      --fe_weights trained_models/resnet101.pth
+
+With no dataset on disk, pass --synthetic (same generated pairs as
+scripts/train.py --synthetic, so the cache slots straight into training).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="extract frozen-trunk features into a durable cache"
+    )
+    p.add_argument("--feature-cache", type=str, required=True,
+                   dest="feature_cache", metavar="DIR",
+                   help="cache root; one store per split is written under "
+                        "DIR/<split>")
+    p.add_argument("--dataset_image_path", type=str,
+                   default="datasets/pf-pascal")
+    p.add_argument("--dataset_csv_path", type=str,
+                   default="datasets/pf-pascal/image_pairs")
+    p.add_argument("--synthetic", action="store_true",
+                   help="extract for the synthetic pair datasets (same "
+                        "sizes/seeds as scripts/train.py --synthetic)")
+    p.add_argument("--synthetic_n", type=int, default=256,
+                   help="synthetic train-set size; keep the default to "
+                        "match scripts/train.py --synthetic (CI smoke "
+                        "runs shrink it)")
+    p.add_argument("--synthetic_val_n", type=int, default=32,
+                   help="synthetic val-set size (train.py uses 32)")
+    p.add_argument("--splits", nargs="+", default=["train", "val"],
+                   choices=("train", "val"),
+                   help="which splits to extract")
+    p.add_argument("--image_size", type=int, default=400)
+    p.add_argument("--batch_size", type=int, default=8,
+                   help="trunk-forward batch during extraction (per split)")
+    p.add_argument("--fe_arch", type=str, default="resnet101")
+    p.add_argument("--fe_weights", type=str, default="",
+                   help="pretrained trunk weights: reference .pth.tar, raw "
+                        "torchvision state dict (.pth), or ncnet_tpu "
+                        ".msgpack")
+    p.add_argument("--checkpoint", type=str, default="",
+                   help="take trunk weights AND architecture from an "
+                        "ncnet_tpu .msgpack checkpoint")
+    p.add_argument("--allow_random_fe", action="store_true",
+                   help="explicitly allow a randomly-initialized trunk "
+                        "(synthetic proofs only — ImageNet features are "
+                        "what make real training work)")
+    p.add_argument("--bf16", action="store_true",
+                   help="extract (and store) bfloat16 features — half the "
+                        "disk/HBM of f32; matches training with --bf16")
+    p.add_argument("--device_normalize", action="store_true",
+                   help="mirror train.py --device_normalize: datasets "
+                        "yield uint8 and normalization runs on device")
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--compile-cache", type=str, default=None,
+                   dest="compile_cache", metavar="DIR",
+                   help="persistent XLA compilation cache directory "
+                        "(default ~/.cache/ncnet_tpu/xla; 'none' disables)")
+    args = p.parse_args(argv)
+
+    from ncnet_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache(args.compile_cache)
+
+    import jax
+
+    from ncnet_tpu.data.pairs import ImagePairDataset, SyntheticPairDataset
+    from ncnet_tpu.features import FeatureStore, populate_store, trunk_digest
+    from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+
+    if args.checkpoint:
+        from ncnet_tpu.train.checkpoint import load_latest_valid
+
+        ck, used = load_latest_valid(args.checkpoint)
+        config = ck.config.replace(half_precision=args.bf16)
+        params = ck.params
+        print(f"trunk + architecture from checkpoint {used}")
+    else:
+        if (
+            not args.fe_weights
+            and not args.synthetic
+            and not args.allow_random_fe
+        ):
+            p.error(
+                "no pretrained trunk: pass --fe_weights or --checkpoint, "
+                "or opt in to a random trunk with --allow_random_fe"
+            )
+        config = ImMatchNetConfig(
+            feature_extraction_cnn=args.fe_arch,
+            half_precision=args.bf16,
+        )
+        params = init_immatchnet(jax.random.PRNGKey(args.seed), config)
+        if args.fe_weights:
+            from ncnet_tpu.utils.convert_torch import load_trunk_weights
+
+            params = dict(params)
+            params["feature_extraction"] = load_trunk_weights(
+                args.fe_weights, cnn=config.feature_extraction_cnn
+            )
+            print(f"loaded trunk weights from {args.fe_weights}")
+
+    size = (args.image_size, args.image_size)
+    if args.synthetic:
+        datasets = {
+            "train": SyntheticPairDataset(
+                n=args.synthetic_n, output_size=size, seed=args.seed
+            ),
+            "val": SyntheticPairDataset(
+                n=args.synthetic_val_n, output_size=size, seed=args.seed + 1
+            ),
+        }
+    else:
+        datasets = {
+            split: ImagePairDataset(
+                os.path.join(args.dataset_csv_path, f"{split}_pairs.csv"),
+                args.dataset_image_path, output_size=size, seed=args.seed,
+                uint8_output=args.device_normalize,
+            )
+            for split in args.splits
+        }
+
+    digest = trunk_digest(params["feature_extraction"], config, size)
+    for split in args.splits:
+        ds = datasets[split]
+        store = FeatureStore.open_or_create(
+            os.path.join(args.feature_cache, split),
+            digest, config, size, len(ds),
+        )
+        n = populate_store(
+            store, params, config, ds,
+            batch_size=min(args.batch_size, len(ds)), log_every=5,
+        )
+        state = "extracted" if n else "already complete;"
+        print(
+            f"[features] {split}: {state} {n or store.num_items} pairs "
+            f"-> {store.root} (digest {digest[:12]}..., "
+            f"dtype {store.manifest['feature_dtype']})",
+            flush=True,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

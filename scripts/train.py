@@ -89,6 +89,32 @@ def main():
                         "substituted by the next loadable index, "
                         "shape-preserving) before failing loudly; 0 = "
                         "fail on the first bad sample")
+    p.add_argument("--feature-cache", type=str, default="",
+                   dest="feature_cache", metavar="DIR",
+                   help="train the NC head from cached trunk features "
+                        "(ncnet_tpu.features): DIR/train and DIR/val hold "
+                        "one durable digest-guarded store per split, "
+                        "populated lazily on first use (or up front by "
+                        "scripts/extract_features.py). Steps then contain "
+                        "ZERO backbone ops. Frozen-trunk configs only — "
+                        "refused with --train_fe/--fe_finetune_params; a "
+                        "cache extracted under different trunk weights, "
+                        "backbone, image size, dtype, or normalize/center "
+                        "flags is DETECTED (manifest digest) and rejected")
+    p.add_argument("--pin-features", action="store_true",
+                   dest="pin_features",
+                   help="with --feature-cache: device_put the WHOLE "
+                        "feature set once and gather batches on device "
+                        "(PF-Pascal train is ~7.6 GB in bf16 — fits a "
+                        "16 GB v5e); refused when the set exceeds the "
+                        "device's reported memory")
+    p.add_argument("--compile-cache", type=str, default=None,
+                   dest="compile_cache", metavar="DIR",
+                   help="persistent XLA compilation cache directory "
+                        "(default ~/.cache/ncnet_tpu/xla; 'none' "
+                        "disables): the minute-scale conv4d NC-stack "
+                        "compiles are paid once per machine, not once "
+                        "per run")
     p.add_argument("--device_normalize", action="store_true",
                    help="ship training images as uint8 and ImageNet-"
                         "normalize on device (4x less H2D traffic; "
@@ -147,6 +173,20 @@ def main():
                         "checkpoint resumes keep their recorded value "
                         "unless --chunk_remat/--no-chunk_remat is given")
     args = p.parse_args()
+
+    from ncnet_tpu.utils.compile_cache import enable_compile_cache
+
+    cache_dir = enable_compile_cache(args.compile_cache)
+    if cache_dir:
+        print(f"persistent compilation cache: {cache_dir}", flush=True)
+
+    if args.feature_cache and (args.train_fe or args.fe_finetune_params):
+        # checked before any device work: the cache holds features of the
+        # PRE-training trunk and would silently go stale after one step
+        p.error(
+            "--feature-cache requires a fully frozen trunk; drop "
+            "--train_fe/--fe_finetune_params or train without the cache"
+        )
 
     if args.sanitize:
         # must happen before any jit tracing: taps are identity at trace
@@ -344,21 +384,69 @@ def main():
     # --batch_size is GLOBAL; each host loads its 1/n_hosts slice and the
     # global array is assembled in shard_batch (parallel/mesh.py)
     local_bs = args.batch_size // n_hosts
+    from_features = bool(args.feature_cache)
+    if from_features:
+        # one digest-guarded store per split; a stale/mismatched cache
+        # raises (FeatureCacheMismatch) instead of training on it. The
+        # populate step is the lazy fill-on-first-epoch: it extracts only
+        # MISSING shards, so a complete cache costs a directory scan.
+        from ncnet_tpu.data.features_loader import FeatureBatchLoader
+        from ncnet_tpu.features import (
+            FeatureStore,
+            populate_store,
+            trunk_digest,
+        )
+
+        digest = trunk_digest(params["feature_extraction"], config, size)
+        stores = {}
+        for split, ds in (("train", train_ds), ("val", val_ds)):
+            store = FeatureStore.open_or_create(
+                os.path.join(args.feature_cache, split),
+                digest, config, size, len(ds),
+            )
+            n_new = populate_store(
+                store, params, config, ds,
+                batch_size=min(8, max(1, len(ds))), log_every=5,
+            )
+            print(
+                f"feature cache {split}: "
+                + (f"extracted {n_new} pairs into" if n_new else "complete,")
+                + f" {store.root}",
+                flush=True,
+            )
+            stores[split] = store
+
+        def make_loader(split, shuffle):
+            return FeatureBatchLoader(
+                stores[split], local_bs, shuffle=shuffle, seed=args.seed,
+                num_workers=args.num_workers, drop_last=True,
+                host_id=host_id, n_hosts=n_hosts,
+                backend=args.loader_backend,
+                sample_retries=args.sample_retries,
+                skip_budget=args.skip_budget,
+                pin_hbm=args.pin_features,
+            )
+
+    else:
+
+        def make_loader(split, shuffle):
+            return DataLoader(
+                train_ds if split == "train" else val_ds, local_bs,
+                shuffle=shuffle, seed=args.seed if shuffle else 0,
+                num_workers=args.num_workers, drop_last=True,
+                host_id=host_id, n_hosts=n_hosts,
+                backend=args.loader_backend,
+                sample_retries=args.sample_retries,
+                skip_budget=args.skip_budget,
+            )
+
     # context-managed loaders + the preemption guard: a SIGTERM (cloud TPU
     # preemption notice) or Ctrl-C checkpoints once at the next step
     # boundary and exits cleanly, with the worker pools shut down on every
     # path (train() also closes the loaders from its own finally)
-    with PreemptionGuard() as guard, DataLoader(
-        train_ds, local_bs, shuffle=True, seed=args.seed,
-        num_workers=args.num_workers, drop_last=True,
-        host_id=host_id, n_hosts=n_hosts, backend=args.loader_backend,
-        sample_retries=args.sample_retries, skip_budget=args.skip_budget,
-    ) as train_loader, DataLoader(
-        val_ds, local_bs, shuffle=False,
-        num_workers=args.num_workers, drop_last=True,
-        host_id=host_id, n_hosts=n_hosts, backend=args.loader_backend,
-        sample_retries=args.sample_retries, skip_budget=args.skip_budget,
-    ) as val_loader:
+    with PreemptionGuard() as guard, make_loader(
+        "train", True
+    ) as train_loader, make_loader("val", False) as val_loader:
         _, history = train(
             config,
             params,
@@ -382,6 +470,7 @@ def main():
             save_every_steps=args.save_every_steps,
             keep_checkpoints=args.keep_checkpoints,
             preemption=guard,
+            from_features=from_features,
         )
     if history.get("preempted"):
         print("exiting after preemption checkpoint (resume with "

@@ -1,0 +1,414 @@
+"""Tests for the frozen-trunk feature cache (ncnet_tpu.features + the
+from-features training path).
+
+The load-bearing guarantees:
+  * the cached-feature path is NUMERICALLY IDENTICAL to the backbone
+    path — same op sequence post-features, so under eager execution the
+    first training steps match bitwise (losses AND NC params); jitted,
+    XLA fuses the trunk-bearing program differently and the match is
+    ULP-tight allclose;
+  * a stale or mismatched cache (different trunk weights / config /
+    dataset size) is REJECTED at open, never silently consumed;
+  * shard bitrot is detected at read (durable sidecar digests);
+  * the `scripts/extract_features.py` CLI stays runnable (CPU smoke on
+    the synthetic dataset).
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+from ncnet_tpu.data.features_loader import FeatureBatchLoader
+from ncnet_tpu.data.loader import collate
+from ncnet_tpu.data.pairs import SyntheticPairDataset
+from ncnet_tpu.features import (
+    FeatureCacheMismatch,
+    FeatureStore,
+    populate_store,
+    trunk_digest,
+)
+from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+from ncnet_tpu.train.loss import weak_loss, weak_loss_from_features
+from ncnet_tpu.train.step import (
+    create_train_state,
+    make_eval_step,
+    make_optimizer,
+    make_train_step,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+CFG = ImMatchNetConfig(ncons_kernel_sizes=(3,), ncons_channels=(1,))
+SIZE = (48, 48)
+N_PAIRS = 8
+
+
+@pytest.fixture(scope="module")
+def cache(tmp_path_factory):
+    """One populated store shared by the module's tests: params, dataset,
+    digest, store (populated via the jitted extractor)."""
+    params = init_immatchnet(jax.random.PRNGKey(0), CFG)
+    ds = SyntheticPairDataset(n=N_PAIRS, output_size=SIZE, seed=3)
+    root = tmp_path_factory.mktemp("feature_cache")
+    digest = trunk_digest(params["feature_extraction"], CFG, SIZE)
+    store = FeatureStore.open_or_create(
+        str(root / "train"), digest, CFG, SIZE, len(ds)
+    )
+    n = populate_store(store, params, CFG, ds, batch_size=4)
+    assert n == N_PAIRS and store.complete()
+    return {"params": params, "ds": ds, "digest": digest, "store": store}
+
+
+def _feature_batch(store, indices):
+    pairs = [store.get(i) for i in indices]
+    return {
+        "source_features": np.stack([p[0] for p in pairs]),
+        "target_features": np.stack([p[1] for p in pairs]),
+    }
+
+
+# --- store ------------------------------------------------------------------
+
+
+def test_populate_is_lazy_and_idempotent(cache):
+    """A complete store re-populates as a no-op (the lazy fill-on-first-
+    epoch contract), and shards round-trip bit-exactly."""
+    assert populate_store(
+        cache["store"], cache["params"], CFG, cache["ds"], batch_size=4
+    ) == 0
+    src, tgt = cache["store"].get(0)
+    assert src.dtype == np.float32 and src.shape == (3, 3, 1024)
+    src2, _ = cache["store"].get(0)
+    np.testing.assert_array_equal(src, src2)
+
+
+def test_store_roundtrip_bf16(tmp_path):
+    """bf16 shards (half the disk/HBM) survive the write/read round-trip
+    bit-exactly via ml_dtypes."""
+    import ml_dtypes
+
+    cfg16 = CFG.replace(half_precision=True)
+    store = FeatureStore.create(str(tmp_path), "d" * 64, cfg16, SIZE, 1)
+    rng = np.random.RandomState(0)
+    feats = rng.randn(3, 3, 7).astype(ml_dtypes.bfloat16)
+    store.put(0, feats, feats)
+    src, tgt = store.get(0)
+    assert src.dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(
+        src.view(np.uint16), feats.view(np.uint16)
+    )
+
+
+def test_trunk_digest_covers_weights_and_config(cache):
+    """The digest must move when anything that changes the feature bytes
+    moves: trunk weights, backbone name, image size, dtype, centering."""
+    base = cache["digest"]
+    other_params = init_immatchnet(jax.random.PRNGKey(1), CFG)
+    assert trunk_digest(
+        other_params["feature_extraction"], CFG, SIZE
+    ) != base
+    fe = cache["params"]["feature_extraction"]
+    assert trunk_digest(fe, CFG, (64, 64)) != base
+    assert trunk_digest(fe, CFG.replace(half_precision=True), SIZE) != base
+    assert trunk_digest(fe, CFG.replace(center_features=True), SIZE) != base
+    # and it is deterministic
+    assert trunk_digest(fe, CFG, SIZE) == base
+
+
+def test_stale_cache_rejected(cache, tmp_path):
+    """A manifest/trunk-digest mismatch RAISES instead of training on
+    stale features — for digest, and for dataset-size drift."""
+    other = init_immatchnet(jax.random.PRNGKey(1), CFG)
+    stale = trunk_digest(other["feature_extraction"], CFG, SIZE)
+    with pytest.raises(FeatureCacheMismatch, match="digest"):
+        FeatureStore.open_store(cache["store"].root, expected_digest=stale)
+    with pytest.raises(FeatureCacheMismatch, match="items"):
+        FeatureStore.open_store(
+            cache["store"].root,
+            expected_digest=cache["digest"],
+            num_items=N_PAIRS + 1,
+        )
+    # open_or_create must NOT fall through to create on a mismatch
+    with pytest.raises(FeatureCacheMismatch):
+        FeatureStore.open_or_create(
+            cache["store"].root, stale, CFG, SIZE, N_PAIRS
+        )
+
+
+def test_shard_bitrot_detected(cache, tmp_path):
+    """Flipped shard bytes fail the sidecar digest at read."""
+    from ncnet_tpu.resilience.durable import IntegrityError
+
+    store = FeatureStore.create(
+        str(tmp_path), cache["digest"], CFG, SIZE, 1
+    )
+    src, tgt = cache["store"].get(0)
+    store.put(0, src, tgt)
+    path = store.shard_path(0, "source")
+    blob = bytearray(open(path, "rb").read())
+    blob[-1] ^= 0xFF
+    with open(path, "wb") as f:  # deliberate raw rewrite: simulated bitrot
+        f.write(bytes(blob))
+    with pytest.raises(IntegrityError):
+        store.get(0)
+
+
+# --- loader -----------------------------------------------------------------
+
+
+def test_feature_loader_batches_and_pinning(cache):
+    """FeatureBatchLoader yields the DataLoader's exact index plan, and
+    the HBM-pinned path is batch-for-batch identical to the unpinned."""
+    with FeatureBatchLoader(
+        cache["store"], 4, shuffle=True, seed=7, num_workers=2
+    ) as ld, FeatureBatchLoader(
+        cache["store"], 4, shuffle=True, seed=7, num_workers=2, pin_hbm=True
+    ) as pinned:
+        assert len(ld) == N_PAIRS // 4
+        a = list(ld.iter_epoch(0))
+        b = list(pinned.iter_epoch(0))
+        assert len(a) == len(b) == N_PAIRS // 4
+        for x, y in zip(a, b):
+            assert x["source_features"].shape == (4, 3, 3, 1024)
+            np.testing.assert_array_equal(
+                np.asarray(x["source_features"]),
+                np.asarray(y["source_features"]),
+            )
+        # skip_batches resume parity, pinned vs not
+        np.testing.assert_array_equal(
+            np.asarray(next(iter(ld.iter_epoch(0, skip_batches=1)))
+                       ["target_features"]),
+            np.asarray(next(iter(pinned.iter_epoch(0, skip_batches=1)))
+                       ["target_features"]),
+        )
+
+
+def test_feature_loader_refuses_incomplete_store(cache, tmp_path):
+    store = FeatureStore.create(
+        str(tmp_path), cache["digest"], CFG, SIZE, 2
+    )
+    with pytest.raises(ValueError, match="missing"):
+        FeatureBatchLoader(store, 2)
+
+
+# --- the equivalence guarantee ---------------------------------------------
+
+
+def test_cached_path_matches_backbone_path(cache, tmp_path):
+    """Three training steps from the cache vs. from images: identical
+    config, identical batches. Eager (disable_jit) both paths execute the
+    same op sequence post-features, so losses AND the updated NC params
+    match BITWISE. The store is populated eagerly too — extraction must
+    run in the regime being compared, since jit-vs-eager extraction
+    itself differs by ULPs. (Jitted, XLA additionally fuses the
+    trunk-bearing program differently and the NC grads pick up ULP-level
+    reduction-order noise — that looser jitted contract is asserted
+    separately below.)"""
+    from ncnet_tpu.models.immatchnet import extract_features
+
+    ds, params = cache["ds"], cache["params"]
+    store = FeatureStore.create(
+        str(tmp_path / "eager"), cache["digest"], CFG, SIZE, len(ds)
+    )
+    idx_batches = [[0, 1, 2, 3], [4, 5, 6, 7], [0, 1, 2, 3]]
+    img_batches = [collate([ds[i] for i in b]) for b in idx_batches]
+    # populate with the STEP's exact batch grouping: XLA reductions are
+    # not batch-size-invariant at the ULP level, so bit-identical cached
+    # features require extracting the same [4,h,w,3] batches the image
+    # path will run (the store round-trip itself is bit-exact)
+    with jax.disable_jit():
+        for b, ib in zip(idx_batches[:2], img_batches[:2]):
+            fs = np.asarray(extract_features(params, CFG,
+                                             ib["source_image"]))
+            ft = np.asarray(extract_features(params, CFG,
+                                             ib["target_image"]))
+            for j, i in enumerate(b):
+                store.put(i, fs[j], ft[j])
+    assert store.complete()
+    feat_batches = [_feature_batch(store, b) for b in idx_batches]
+
+    opt = make_optimizer(1e-3)
+    with jax.disable_jit():
+        s_img = create_train_state(params, opt)
+        s_ft = create_train_state(params, opt)
+        step_img = make_train_step(CFG, opt, donate=False)
+        step_ft = make_train_step(CFG, opt, donate=False, from_features=True)
+        losses_img, losses_ft = [], []
+        for bi, bf in zip(img_batches, feat_batches):
+            s_img, l_img = step_img(s_img, bi)
+            s_ft, l_ft = step_ft(s_ft, bf)
+            losses_img.append(float(l_img))
+            losses_ft.append(float(l_ft))
+    assert losses_ft == losses_img  # bitwise: exact float equality
+    for a, b in zip(
+        jax.tree.leaves(s_img.params["neigh_consensus"]),
+        jax.tree.leaves(s_ft.params["neigh_consensus"]),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_cached_path_matches_backbone_path_jitted(cache):
+    """The jitted contract: same three steps, losses and NC params
+    allclose (ULP-scale fusion noise only)."""
+    ds, store, params = cache["ds"], cache["store"], cache["params"]
+    idx_batches = [[0, 1, 2, 3], [4, 5, 6, 7], [0, 1, 2, 3]]
+    img_batches = [collate([ds[i] for i in b]) for b in idx_batches]
+    feat_batches = [_feature_batch(store, b) for b in idx_batches]
+
+    opt = make_optimizer(1e-3)
+    s_img = create_train_state(params, opt)
+    s_ft = create_train_state(params, opt)
+    step_img = make_train_step(CFG, opt, donate=False)
+    step_ft = make_train_step(CFG, opt, donate=False, from_features=True)
+    for bi, bf in zip(img_batches, feat_batches):
+        s_img, l_img = step_img(s_img, bi)
+        s_ft, l_ft = step_ft(s_ft, bf)
+        np.testing.assert_allclose(
+            float(l_ft), float(l_img), rtol=1e-4, atol=1e-7
+        )
+    for a, b in zip(
+        jax.tree.leaves(s_img.params["neigh_consensus"]),
+        jax.tree.leaves(s_ft.params["neigh_consensus"]),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
+        )
+
+
+def test_eval_step_from_features_matches_loss(cache):
+    batch = _feature_batch(cache["store"], [0, 1, 2, 3])
+    ev = make_eval_step(CFG, from_features=True)
+    np.testing.assert_allclose(
+        float(ev(cache["params"], batch)),
+        float(weak_loss_from_features(cache["params"], CFG, batch)),
+        atol=1e-7,
+    )
+    # and against the image-path loss on the matching image batch
+    img = collate([cache["ds"][i] for i in (0, 1, 2, 3)])
+    np.testing.assert_allclose(
+        float(ev(cache["params"], batch)),
+        float(weak_loss(cache["params"], CFG, img)),
+        rtol=1e-5, atol=1e-7,
+    )
+
+
+def test_from_features_refuses_training_trunk():
+    """A cache under a training trunk would silently go stale; every
+    entry point must refuse loudly at construction time."""
+    from ncnet_tpu.train.loop import train as train_loop
+
+    opt = make_optimizer()
+    with pytest.raises(ValueError, match="frozen"):
+        make_train_step(CFG, opt, from_features=True, train_fe=True)
+    with pytest.raises(ValueError, match="frozen"):
+        make_train_step(CFG, opt, from_features=True, fe_finetune_blocks=1)
+    params = init_immatchnet(jax.random.PRNGKey(0), CFG)
+    with pytest.raises(ValueError, match="frozen"):
+        train_loop(
+            CFG, params, [], num_epochs=1, train_fe=True,
+            from_features=True, data_parallel=False,
+        )
+
+
+def test_train_loop_from_features_end_to_end(cache, tmp_path):
+    """loop.train() consumes a FeatureBatchLoader: one epoch trains,
+    validates, and persists metrics — no image ever enters the loop."""
+    import json
+
+    from ncnet_tpu.train.loop import train as train_loop
+
+    with FeatureBatchLoader(
+        cache["store"], 4, shuffle=True, seed=7, num_workers=2
+    ) as tl, FeatureBatchLoader(
+        cache["store"], 4, num_workers=2
+    ) as vl:
+        _, hist = train_loop(
+            CFG, cache["params"], tl, val_loader=vl, num_epochs=1,
+            checkpoint_dir=str(tmp_path), data_parallel=False,
+            log_every=100, from_features=True,
+        )
+    assert len(hist["train_loss"]) == 1
+    assert np.isfinite(hist["train_loss"][0])
+    assert np.isfinite(hist["val_loss"][0])
+    lines = [
+        json.loads(l)
+        for l in (tmp_path / "metrics.jsonl").read_text().splitlines()
+    ]
+    assert lines[0]["epoch"] == 1 and np.isfinite(lines[0]["val_loss"])
+
+
+# --- analytic FLOP accounting (bench.py) ------------------------------------
+
+
+def test_train_step_flops_drops_exactly_the_trunk():
+    sys.path.insert(0, str(REPO))
+    from bench import train_step_flops
+
+    k, c = (5, 5, 5), (16, 16, 1)
+    full = train_step_flops(16, k, c)
+    cached = train_step_flops(16, k, c, from_features=True)
+    trunk = 16 * 2 * 6.5e9 * (400 / 224.0) ** 2
+    assert cached < full
+    np.testing.assert_allclose(full - cached, trunk, rtol=1e-12)
+
+
+# --- CLI smoke (CI/tooling: the extractor can't rot) ------------------------
+
+
+def test_extract_features_cli_smoke(tmp_path):
+    """scripts/extract_features.py on the synthetic dataset, CPU: first
+    run populates both splits, second run is a no-op on a complete cache,
+    and the stores open clean."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable,
+        str(REPO / "scripts" / "extract_features.py"),
+        "--feature-cache", str(tmp_path / "cache"),
+        "--synthetic", "--synthetic_n", "4", "--synthetic_val_n", "2",
+        "--image_size", "32", "--batch_size", "2",
+        "--compile-cache", str(tmp_path / "xla_cache"),
+    ]
+    r = subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=300
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "extracted 4 pairs" in r.stdout, r.stdout
+
+    r2 = subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=300
+    )
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "already complete" in r2.stdout, r2.stdout
+
+    for split, n in (("train", 4), ("val", 2)):
+        store = FeatureStore.open_store(str(tmp_path / "cache" / split))
+        assert store.num_items == n and store.complete()
+
+
+# --- lint gate extension ----------------------------------------------------
+
+
+def test_features_tree_lints_clean():
+    """The repo-wide gate (test_analysis) sweeps ncnet_tpu/ recursively —
+    this pins the NEW subsystem files explicitly so a future restructure
+    can't silently drop them from the sweep."""
+    from ncnet_tpu.analysis import rules  # noqa: F401  (registers rules)
+    from ncnet_tpu.analysis.engine import SEVERITY_ORDER, lint_paths
+
+    paths = [
+        str(REPO / "ncnet_tpu" / "features"),
+        str(REPO / "ncnet_tpu" / "data" / "features_loader.py"),
+        str(REPO / "ncnet_tpu" / "utils" / "compile_cache.py"),
+        str(REPO / "scripts" / "extract_features.py"),
+    ]
+    findings = [
+        f for f in lint_paths(paths)
+        if SEVERITY_ORDER[f.severity] >= SEVERITY_ORDER["warning"]
+    ]
+    assert not findings, "\n".join(f.format() for f in findings)

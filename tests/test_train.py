@@ -317,6 +317,35 @@ def test_prefetch_device_batches_order_and_count():
             assert float(b["target_image"][0, 0, 0, 0]) == -i
 
 
+def test_loss_log_converts_each_loss_exactly_once():
+    """The mid-epoch snapshot path (loop._LossLog) must transfer each
+    device loss to host EXACTLY once, however many times the host list is
+    requested — the old code re-float()ed the whole prefix per snapshot,
+    O(n^2) D2H syncs per epoch."""
+    from ncnet_tpu.train.loop import _LossLog
+
+    conversions = []
+
+    class FakeDeviceScalar:
+        def __init__(self, v):
+            self.v = v
+
+        def __float__(self):
+            conversions.append(self.v)
+            return self.v
+
+    log = _LossLog(seed_losses=[1.0, 2.0])  # seeded host floats: no syncs
+    for i in range(5):
+        log.append(FakeDeviceScalar(float(i)))
+        # a snapshot after every step — the worst case for the old code
+        assert log.host() == [1.0, 2.0] + [float(j) for j in range(i + 1)]
+        assert len(log) == 2 + i + 1
+    # 5 appends, 5 snapshots, exactly 5 conversions (not 1+2+3+4+5)
+    assert conversions == [0.0, 1.0, 2.0, 3.0, 4.0]
+    assert log.host() == [1.0, 2.0, 0.0, 1.0, 2.0, 3.0, 4.0]
+    assert len(conversions) == 5
+
+
 def test_train_loop_persists_metrics_and_curve(tmp_path):
     """One tiny epoch end-to-end through loop.train(): metrics.jsonl and
     loss_curve.png are written next to the checkpoint (SURVEY §5 — the
