@@ -20,6 +20,14 @@ contains zero backbone ops — the analytic count and MFU then use the
 reduced, trunk-free total, so the cached step's MFU is not inflated by
 FLOPs it never executed.
 
+``--nc-topk K`` benchmarks the sparse-band NC step (ncnet_tpu.sparse,
+arXiv:2004.10566): the NC stack runs on the top-K correlation band, so
+its analytic FLOPs shrink by (grid^2)/K. Same honest-accounting rule as
+the feature cache: the reported count and MFU use the BAND total, and
+the JSON carries nc_topk, band_occupancy, and the dense-equivalent
+analytic TFLOP/step so sparse and dense BENCH_r*.json trajectories stay
+comparable.
+
 Measured formulation ceiling (rounds 2-3, v5e). Round-3 calibrations: a
 plain [M, 400] @ [400, 400] GEMM sustains ~200 TFLOP/s on this chip and
 the tlc conv3d runs at 137 TFLOP/s hardware — the MXU is NOT the limit;
@@ -136,7 +144,7 @@ CONFIGS = {
 
 
 def train_step_flops(batch, kernels, channels, grid=25, feat_ch=1024,
-                     image=400, from_features=False):
+                     image=400, from_features=False, nc_topk=0):
     """Analytic FLOPs (2*MACs) per training step.
 
     Counted: 2 trunk forwards/sample (features reused for the rolled
@@ -145,15 +153,24 @@ def train_step_flops(batch, kernels, channels, grid=25, feat_ch=1024,
     takes no backward). With ``from_features`` (the feature cache,
     ncnet_tpu.features) the step contains ZERO backbone ops, so the trunk
     term drops out and MFU is reported against the reduced count.
+
+    With ``nc_topk`` > 0 (sparse band, ncnet_tpu.sparse) the NC layers
+    run on ``hA*wA * K`` band entries instead of the dense
+    ``hA*wA * hB*wB`` support — the per-layer count becomes
+    ``2 * grid^2 * min(K, grid^2) * k^4 * cin * cout`` — and MFU is
+    reported against the reduced count. The top-K selection, pointer
+    build, and gathers are integer/comparison work and are not counted
+    (the correlation einsum, which the sparse path still runs, is).
     """
     resnet101_layer3_224 = 6.5e9  # conv1..layer3 @ 224x224 per image
     trunk = 2 * resnet101_layer3_224 * (image / 224.0) ** 2
     if from_features:
         trunk = 0.0
     corr = 2 * 2.0 * grid**4 * feat_ch  # pos + neg
+    n_b = grid**2 if not nc_topk else min(int(nc_topk), grid**2)
     nc_channels = [1, *channels]
     nc_pass = sum(
-        2.0 * grid**4 * k**4 * cin * cout
+        2.0 * grid**2 * n_b * k**4 * cin * cout
         for k, cin, cout in zip(kernels, nc_channels[:-1], nc_channels[1:])
     )
     nc_fwd = nc_pass * 2 * 2  # symmetric x (pos + neg)
@@ -203,6 +220,23 @@ def main():
                         "disables): the minute-scale conv4d NC-stack "
                         "compiles are paid once per machine, not once "
                         "per run")
+    p.add_argument("--nc-topk", type=int, default=0, dest="nc_topk",
+                   metavar="K",
+                   help="sparse-band neighbourhood consensus "
+                        "(ncnet_tpu.sparse): keep only the top-K "
+                        "B-candidates per A-cell and run the NC stack on "
+                        "that band — analytic NC FLOPs drop by "
+                        "(grid^2)/K. 0 = dense. The analytic count and "
+                        "MFU use the BAND total; the JSON also records "
+                        "the dense-equivalent count "
+                        "(analytic_tflop_per_step_dense) and the band "
+                        "occupancy so sparse and dense trajectories stay "
+                        "comparable")
+    p.add_argument("--nc-topk-mutual", action=argparse.BooleanOptionalAction,
+                   default=True, dest="nc_topk_mutual",
+                   help="with --nc-topk: symmetric/mutual band selection "
+                        "(union of per-A and per-B ranks, swap-closed up "
+                        "to capacity) vs plain per-A top-K")
     p.add_argument("--image_size", type=int, default=400,
                    help="square input size; 400 is the flagship config — "
                         "smaller sizes are CPU-proxy runs (the JSON is "
@@ -256,6 +290,8 @@ def main():
         loss_chunk=loss_chunk,
         loss_chunk_remat=args.chunk_remat,
         symmetric_batch=not args.sym_seq,
+        nc_topk=args.nc_topk,
+        nc_topk_mutual=args.nc_topk_mutual,
     )
     params = init_immatchnet(jax.random.PRNGKey(0), config)
     optimizer = make_optimizer()
@@ -337,11 +373,28 @@ def main():
         print(sanitizer.report_text(), flush=True)
 
     pairs_per_sec = batch_size * n_steps / dt
+    grid = size // 16
     step_flops = train_step_flops(
         batch_size, preset["kernels"], preset["channels"],
-        grid=size // 16, image=size, from_features=from_features,
+        grid=grid, image=size, from_features=from_features,
+        nc_topk=args.nc_topk,
     )
     mfu = (step_flops * n_steps / dt) / V5E_BF16_PEAK_FLOPS
+    sparse_extras = {}
+    if args.nc_topk:
+        # the dense-vs-band analytic pair: BENCH_r*.json trajectories stay
+        # comparable across sparse and dense runs (mirrors the
+        # --feature-cache accounting, which also reports the reduced count)
+        dense_flops = train_step_flops(
+            batch_size, preset["kernels"], preset["channels"],
+            grid=grid, image=size, from_features=from_features,
+        )
+        k_eff = min(args.nc_topk, grid**2)
+        sparse_extras = {
+            "nc_topk": k_eff,
+            "band_occupancy": round(k_eff / grid**2, 4),
+            "analytic_tflop_per_step_dense": round(dense_flops / 1e12, 2),
+        }
     print(
         json.dumps(
             {
@@ -356,6 +409,7 @@ def main():
                 "step_ms": round(dt / n_steps * 1e3, 1),
                 "analytic_tflop_per_step": round(step_flops / 1e12, 2),
                 "mfu_vs_v5e_bf16_peak": round(mfu, 4),
+                **sparse_extras,
                 **({"feature_cache": True} if from_features else {}),
                 **({"image_size": size} if size != 400 else {}),
                 **({"sanitized": True} if args.sanitize else {}),

@@ -1,0 +1,108 @@
+"""Microbench: maxpool4d strided-slice accumulation vs the 9D reshape.
+
+The original `ops.matching.maxpool4d` built a transposed 9D blocked
+intermediate (``[b, d1/k, d2/k, d3/k, d4/k, k, k, k, k]``) before one
+argmax — the repo's measured layout law (bench.py header, law 1) is that
+>=6D intermediates draw pathological TPU layouts (4-10x tile padding).
+The shipped reformulation max-accumulates ``k^4`` strided 5D slices, the
+same shape `correlation_maxpool4d` uses, with bit-identical
+``(pooled, offsets)`` outputs (tie-break preserved: ascending combo
+order with strict ``>`` == argmax-first).
+
+Usage:
+  python benchmarks/micro_maxpool.py [--grid 48] [--batch 4] [--k 2]
+                                     [--iters 20]
+
+Prints one JSON line per variant with ms/call; the 9D variant is kept
+inline here (only) as the measured baseline.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.ops.matching import maxpool4d
+
+
+def maxpool4d_9d(corr, k_size):
+    """The pre-fix blocked formulation (transposed 9D intermediate)."""
+    k = int(k_size)
+    b, d1, d2, d3, d4 = corr.shape
+    blocks = corr.reshape(b, d1 // k, k, d2 // k, k, d3 // k, k, d4 // k, k)
+    blocks = blocks.transpose(0, 1, 3, 5, 7, 2, 4, 6, 8)
+    flat = blocks.reshape(b, d1 // k, d2 // k, d3 // k, d4 // k, k**4)
+    pooled = jnp.max(flat, axis=-1)
+    idx = jnp.argmax(flat, axis=-1).astype(jnp.int32)
+    dl = idx % k
+    dk = (idx // k) % k
+    dj = (idx // (k * k)) % k
+    di = idx // (k * k * k)
+    return pooled, (di, dj, dk, dl)
+
+
+def time_fn(fn, corr, iters):
+    out = fn(corr)
+    # force execution: D2H of a scalar reduce of every output
+    float(jnp.sum(out[0]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(corr)
+    host = float(jnp.sum(out[0]) + sum(jnp.sum(d) for d in out[1]))
+    dt = (time.perf_counter() - t0) / iters
+    if not np.isfinite(host):
+        raise RuntimeError("non-finite microbench output")
+    return dt * 1e3
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--grid", type=int, default=48)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--k", type=int, default=2)
+    p.add_argument("--iters", type=int, default=20)
+    args = p.parse_args()
+
+    rng = np.random.RandomState(0)
+    corr = jnp.asarray(
+        rng.randn(args.batch, args.grid, args.grid, args.grid, args.grid)
+        .astype(np.float32)
+    )
+
+    slices = jax.jit(lambda c: maxpool4d(c, args.k))
+    blocked = jax.jit(lambda c: maxpool4d_9d(c, args.k))
+
+    # identical outputs before timing anything
+    a, da = slices(corr)
+    b, db = blocked(corr)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for x, y in zip(da, db):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    for name, fn in (("strided-slices", slices), ("blocked-9d", blocked)):
+        ms = time_fn(fn, corr, args.iters)
+        print(
+            json.dumps(
+                {
+                    "metric": f"maxpool4d_{name}",
+                    "value": round(ms, 3),
+                    "unit": "ms/call",
+                    "grid": args.grid,
+                    "batch": args.batch,
+                    "k": args.k,
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,9 @@ arXiv:2004.10566, the low-precision normalization fragility):
   non-atomic-artifact-write checkpoint/metrics artifacts written with a bare
                             ``open(path, "wb")`` (torn by preemption) instead
                             of the durable temp+fsync+rename helper
+  unchecked-gather          ``jnp.take``/``take_along_axis``/``.at[...].get()``
+                            without an explicit ``mode=`` (the silent clamp
+                            default masks out-of-range index bugs)
 
 All rules are intentionally conservative (intra-module reasoning only, one
 level of name expansion): a finding should mean something; the escape hatch
@@ -468,6 +471,60 @@ def non_atomic_artifact_write(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str
                 "kill mid-write tears the file; use resilience.durable."
                 "durable_write_bytes (temp + fsync + rename + digest)"
             )
+
+
+# --- unchecked-gather -------------------------------------------------------
+
+#: jnp gather entry points whose ``mode`` argument selects the out-of-bounds
+#: semantics (None defaults to silent clamping under jit)
+_GATHER_CALLS = {
+    "jax.numpy.take",
+    "jax.numpy.take_along_axis",
+}
+
+
+@rule(
+    "unchecked-gather",
+    "warning",
+    doc="`jnp.take`/`jnp.take_along_axis`/`x.at[...].get()` without an "
+        "explicit `mode=`: under jit, out-of-bounds indices are silently "
+        "CLAMPED to the edge — a wrong band/gather index reads a plausible "
+        "value instead of failing, masking the bug (the sparse-band "
+        "pointer-table hazard class). Pass mode= ('fill' / 'clip' / "
+        "'promise_in_bounds') chosen on purpose.",
+)
+def unchecked_gather(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    def has_mode(call: ast.Call) -> bool:
+        return any(kw.arg == "mode" for kw in call.keywords)
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = ctx.canonical(node.func)
+        if name in _GATHER_CALLS:
+            if not has_mode(node):
+                short = name.rsplit(".", 1)[-1]
+                yield node, (
+                    f"jnp.{short} without an explicit mode=: out-of-bounds "
+                    "indices clamp silently under jit, masking index bugs; "
+                    "state the intended semantics ('fill', 'clip', or "
+                    "'promise_in_bounds')"
+                )
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"
+            and isinstance(node.func.value, ast.Subscript)
+            and isinstance(node.func.value.value, ast.Attribute)
+            and node.func.value.value.attr == "at"
+        ):
+            # x.at[...].get(...) — the indexed-read form of the same gather
+            if not has_mode(node):
+                yield node, (
+                    ".at[...].get() without an explicit mode=: "
+                    "out-of-bounds indices clamp silently under jit, "
+                    "masking index bugs; state the intended semantics "
+                    "('fill', 'clip', or 'promise_in_bounds')"
+                )
 
 
 # --- mutable-default-arg ----------------------------------------------------

@@ -134,8 +134,15 @@ device_resize_uint8 = jax.jit(_device_resize_uint8, static_argnums=(1, 2))
 
 
 def make_match_fn(config, mesh=None, softmax=True, device_preprocess=False,
-                  concat_directions=False):
+                  concat_directions=False, from_features=False):
     """(params, src, tgt) -> (fwd, rev) match tuples for one pair (jittable).
+
+    ``from_features=True`` consumes PRECOMPUTED trunk features instead of
+    images: ``src``/``tgt`` are ``[1, fh, fw, c]`` feature maps (e.g. from
+    the gallery feature store) and the forward contains zero backbone ops
+    — the correlation/NC pipeline is identical. Incompatible with
+    ``device_preprocess`` (there is no image to normalize) and ``mesh``
+    (the sharded pipeline manages its own extraction).
 
     ``concat_directions=True`` (the both-directions dump's mode) returns
     ONE ``[5, b, n_fwd + n_rev]`` array instead of the (fwd, rev) pair —
@@ -157,7 +164,24 @@ def make_match_fn(config, mesh=None, softmax=True, device_preprocess=False,
 
     k = config.relocalization_k_size
 
-    if mesh is None:
+    if from_features:
+        if device_preprocess:
+            raise ValueError(
+                "from_features match fns take feature maps, not images; "
+                "device_preprocess does not apply"
+            )
+        if mesh is not None:
+            raise ValueError(
+                "from_features is not supported with a spatial mesh (the "
+                "sharded pipeline manages its own feature extraction)"
+            )
+        from ncnet_tpu.models.immatchnet import match_pipeline
+
+        def forward(params, src, tgt):
+            return match_pipeline(
+                params["neigh_consensus"], config, src, tgt
+            )
+    elif mesh is None:
         def forward(params, src, tgt):
             return immatchnet_apply(params, config, src, tgt)
     else:
@@ -265,6 +289,43 @@ def match_pair(match_fn, params, src, tgt, k_size, stride=16,
     return xa, ya, xb, yb, score
 
 
+def _atomic_savemat(out_path, payload):
+    """savemat into a temp name + atomic rename: resume treats any
+    existing ``<q+1>.mat`` as complete, so a crash mid-write must never
+    leave a file under the final name."""
+    from scipy.io import savemat
+
+    tmp = f"{out_path}.tmp.{os.getpid()}"
+    try:
+        savemat(tmp, payload, do_compression=True)
+        os.replace(tmp, out_path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def _clean_stale_temps(output_dir):
+    """Remove torn ``.mat.tmp.<pid>`` files left by a killed run — but
+    NOT temps owned by a still-running dump sharing this directory (a
+    second resume process must not delete the first's in-flight file)."""
+    for stale in os.listdir(output_dir):
+        if ".mat.tmp." not in stale:
+            continue
+        try:
+            owner = int(stale.rsplit(".", 1)[-1])
+            os.kill(owner, 0)  # raises if no such process
+            continue  # owner alive: leave its temp alone
+        except (ValueError, ProcessLookupError):
+            pass
+        except PermissionError:
+            continue  # pid exists under another uid: leave it
+        try:
+            os.unlink(os.path.join(output_dir, stale))
+        except FileNotFoundError:
+            pass  # a concurrent starter already cleaned it
+
+
 def n_match_slots(image_size, k_size, both_directions):
     """Fixed slot count of the .mat contract (eval_inloc.py:116-118)."""
     g = image_size * SCALE_FACTOR / k_size
@@ -289,8 +350,24 @@ def dump_matches(
     softmax=True,
     device_preprocess=False,
     device_resize=False,
+    feature_store_dir=None,
 ):
     """Run the full dump. Writes ``<output_dir>/<q+1>.mat`` per query.
+
+    ``feature_store_dir``: directory of a
+    :class:`ncnet_tpu.features.GalleryFeatureStore` (created on first
+    use, digest-guarded — a store extracted under different trunk
+    weights/config is REJECTED, never silently matched against).
+    Database-pano trunk features are then read from the store instead of
+    re-running the trunk per query-pano pair: each pano's backbone
+    forward runs once EVER (across queries AND dump restarts), and each
+    query's once per query — the reference re-extracts both images for
+    every pair, so at the standard 10-pano shortlist the trunk work
+    drops ~10x per query visit and to ~zero on re-runs. Incompatible
+    with ``mesh``/``device_preprocess``/``device_resize`` (the store
+    path has its own host pipeline; panos ship as features — 1.28 MB
+    bf16 at the (2400, 3200) bucket vs 5.8 MB uint8 original — so the
+    transfer engineering of the image path does not apply).
 
     ``mesh``: optional Mesh with a 'spatial' axis — shards the correlation
     pipeline over A-grid rows for resolutions beyond single-chip HBM. The
@@ -330,7 +407,20 @@ def dump_matches(
     """
     import concurrent.futures
 
-    from scipy.io import loadmat, savemat
+    from scipy.io import loadmat
+
+    if feature_store_dir is not None:
+        if mesh is not None or device_preprocess or device_resize:
+            raise ValueError(
+                "feature_store_dir is incompatible with mesh/"
+                "device_preprocess/device_resize (the gallery-store dump "
+                "has its own pipeline; see dump_matches docstring)"
+            )
+        return _dump_matches_from_store(
+            params, config, shortlist_path, query_path, pano_path,
+            output_dir, image_size, n_queries, n_panos, both_directions,
+            flip_direction, verbose, softmax, feature_store_dir,
+        )
 
     if device_resize and not device_preprocess:
         raise ValueError(
@@ -378,24 +468,8 @@ def dump_matches(
         return out if device_resize else (out, None)
 
     # a killed run can leave torn temp files behind; they are never read
-    # by resume (only exact `<q+1>.mat` names are), just clean them up —
-    # but NOT temps owned by a still-running dump sharing this directory
-    # (a second resume process must not delete the first's in-flight file)
-    for stale in os.listdir(output_dir):
-        if ".mat.tmp." not in stale:
-            continue
-        try:
-            owner = int(stale.rsplit(".", 1)[-1])
-            os.kill(owner, 0)  # raises if no such process
-            continue  # owner alive: leave its temp alone
-        except (ValueError, ProcessLookupError):
-            pass
-        except PermissionError:
-            continue  # pid exists under another uid: leave it
-        try:
-            os.unlink(os.path.join(output_dir, stale))
-        except FileNotFoundError:
-            pass  # a concurrent starter already cleaned it
+    # by resume (only exact `<q+1>.mat` names are), just clean them up
+    _clean_stale_temps(output_dir)
 
     # (root, fn) jobs for every missing pair, in dump order: queries are
     # interleaved with their panos so one prefetch slot always holds the
@@ -410,19 +484,6 @@ def dump_matches(
         jobs.append((query_path, _to_str(db[q][0])))
         for idx in range(n_panos):
             jobs.append((pano_path, _to_str(db[q][1].ravel()[idx])))
-
-    def atomic_savemat(out_path, payload):
-        # savemat into a temp name + atomic rename: resume treats any
-        # existing `<q+1>.mat` as complete, so a crash mid-write must
-        # never leave a file under the final name
-        tmp = f"{out_path}.tmp.{os.getpid()}"
-        try:
-            savemat(tmp, payload, do_compression=True)
-            os.replace(tmp, out_path)
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
 
     n_slots = n_match_slots(image_size, k_size, both_directions)
     import collections
@@ -515,7 +576,7 @@ def dump_matches(
                 # off the consume loop so the device never waits on it
                 writes.append(
                     writer.submit(
-                        atomic_savemat,
+                        _atomic_savemat,
                         out_path,
                         {"matches": matches, "query_fn": _to_str(db[q][0]),
                          "pano_fn": pano_fn_all},
@@ -548,3 +609,111 @@ def dump_matches(
         while inflight:
             consume()
         flush_writes(keep=0)
+
+
+def _dump_matches_from_store(
+    params, config, shortlist_path, query_path, pano_path, output_dir,
+    image_size, n_queries, n_panos, both_directions, flip_direction,
+    verbose, softmax, feature_store_dir,
+):
+    """The gallery-feature-store dump loop (ROADMAP "Precomputed gallery
+    features for InLoc-style retrieval").
+
+    Per query: ONE trunk forward for the query image; per pano: a store
+    lookup (trunk forward only on first-ever visit, durably cached across
+    queries and dump restarts). The NC/correlation match runs from
+    features via `make_match_fn(from_features=True)` — identical math to
+    the image path, the backbone simply never reruns. Cached panos skip
+    image loading entirely: the feature shard self-describes its grid,
+    and the .mat coordinate contract only needs the grid (times the
+    backbone stride).
+
+    Kept deliberately simpler than the image loop's transfer pipeline:
+    what that engineering hides (fp32/uint8 image H2D, host decode) the
+    store path mostly eliminates at the source — features are ~4x
+    smaller than even the uint8 device_resize wire format, and the pano
+    decode+resize+trunk work disappears for every cached visit.
+    """
+    from scipy.io import loadmat
+
+    from ncnet_tpu.features import GalleryFeatureStore, trunk_digest
+    from ncnet_tpu.models.immatchnet import extract_features
+
+    k_size = config.relocalization_k_size
+    stride = backbone_stride(config.feature_extraction_cnn)
+    if stride != int(1 / SCALE_FACTOR):
+        raise ValueError(
+            f"backbone stride {stride} does not match the dump's "
+            f"SCALE_FACTOR {SCALE_FACTOR} (expects stride "
+            f"{int(1 / SCALE_FACTOR)}); the .mat coordinate contract "
+            "assumes the reference's 1/16 feature stride"
+        )
+
+    store = GalleryFeatureStore.open_or_create(
+        feature_store_dir,
+        trunk_digest(params["feature_extraction"], config, None),
+        config,
+    )
+    extractor = jax.jit(lambda p, img: extract_features(p, config, img))
+    concat = both_directions
+    match_fn = jax.jit(
+        make_match_fn(
+            config, softmax=softmax, concat_directions=concat,
+            from_features=True,
+        )
+    )
+
+    def extract_from_disk(root, fn):
+        img = load_and_preprocess(
+            os.path.join(root, fn), image_size, k_size
+        )
+        return extractor(params, jnp.asarray(img))
+
+    def pano_features(fn):
+        # keyed by the shortlist-relative filename: stable across hosts
+        # and dataset roots (the digest pins the trunk side)
+        if store.has(fn):
+            return jnp.asarray(store.get(fn))
+        feats = extract_from_disk(pano_path, fn)
+        store.put(fn, np.asarray(feats))
+        return feats
+
+    dbmat = loadmat(shortlist_path)
+    db = dbmat["ImgList"][0, :]
+    pano_fn_all = np.vstack(tuple(db[q][1] for q in range(len(db))))
+
+    os.makedirs(output_dir, exist_ok=True)
+    _clean_stale_temps(output_dir)
+    n_slots = n_match_slots(image_size, k_size, both_directions)
+
+    for q in range(n_queries):
+        out_path = os.path.join(output_dir, f"{q + 1}.mat")
+        if os.path.exists(out_path):  # resumable, like the image loop
+            continue
+        qfeat = extract_from_disk(query_path, _to_str(db[q][0]))
+        q_shape = (1, qfeat.shape[1] * stride, qfeat.shape[2] * stride, 3)
+        matches = np.zeros((1, n_panos, n_slots, 5))
+        for idx in range(n_panos):
+            pfeat = pano_features(_to_str(db[q][1].ravel()[idx]))
+            p_shape = (
+                1, pfeat.shape[1] * stride, pfeat.shape[2] * stride, 3
+            )
+            out = match_fn(params, qfeat, pfeat)
+            xa, ya, xb, yb, score = match_pair(
+                None, None, None, None, k_size, stride,
+                both_directions, flip_direction, precomputed=out,
+                shapes=(q_shape, p_shape),
+            )
+            n = min(len(xa), n_slots)
+            matches[0, idx, :n, 0] = xa[:n]
+            matches[0, idx, :n, 1] = ya[:n]
+            matches[0, idx, :n, 2] = xb[:n]
+            matches[0, idx, :n, 3] = yb[:n]
+            matches[0, idx, :n, 4] = score[:n]
+        _atomic_savemat(
+            out_path,
+            {"matches": matches, "query_fn": _to_str(db[q][0]),
+             "pano_fn": pano_fn_all},
+        )
+        if verbose:
+            print(f"query {q + 1}/{n_queries} -> {out_path}", flush=True)

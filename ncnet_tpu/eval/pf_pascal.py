@@ -72,3 +72,23 @@ def evaluate(params, config, loader, alpha=0.1, verbose=True):
         "per_pair": per_pair,
         "n_valid": int(valid.sum()),
     }
+
+
+def pck_vs_topk(params, config, loader, ks, alpha=0.1, verbose=False):
+    """PF-Pascal PCK across sparse band widths (ncnet_tpu.sparse).
+
+    Evaluates the SAME loader at every ``nc_topk`` in ``ks`` (0 = dense;
+    the readout path is `corr_to_matches` on the densified band either
+    way — see models/immatchnet.match_pipeline). Returns ``{k: result
+    dict}`` in the `evaluate` schema; with ``k >= hB*wB`` the result must
+    match the dense one, which anchors the accuracy/compute trade-off
+    curve the sweep exists to measure.
+    """
+    batches = list(loader)
+    return {
+        int(k): evaluate(
+            params, config.replace(nc_topk=int(k)), batches,
+            alpha=alpha, verbose=verbose,
+        )
+        for k in ks
+    }

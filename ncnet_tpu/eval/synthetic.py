@@ -73,3 +73,26 @@ def evaluate_synthetic(params, config, loader, alpha=0.1, n_side=4):
     arr = np.asarray(scores)
     valid = ~np.isnan(arr)
     return float(arr[valid].mean()) if valid.any() else float("nan")
+
+
+def synthetic_pck_vs_topk(params, config, batches, ks, alpha=0.1, n_side=4):
+    """Synthetic-transfer PCK across sparse band widths (accuracy/compute
+    sweep for the sparse NC path, ncnet_tpu.sparse).
+
+    Args:
+      batches: a list (or reusable loader) of shift-annotated batches —
+        the SAME pairs are scored at every K so the sweep isolates the
+        band width.
+      ks: iterable of ``nc_topk`` values; 0 = the dense path.
+
+    Returns:
+      ``{k: mean_pck}``. With ``k >= hB*wB`` the band is complete and the
+      entry must equal the dense one — the sanity anchor of the sweep.
+    """
+    cached = list(batches)
+    return {
+        int(k): evaluate_synthetic(
+            params, config.replace(nc_topk=int(k)), cached, alpha, n_side
+        )
+        for k in ks
+    }

@@ -14,6 +14,7 @@ from ncnet_tpu.features.extract import make_batch_extractor, populate_store
 from ncnet_tpu.features.store import (
     FeatureCacheMismatch,
     FeatureStore,
+    GalleryFeatureStore,
     feature_dtype_name,
     trunk_digest,
 )
@@ -21,6 +22,7 @@ from ncnet_tpu.features.store import (
 __all__ = [
     "FeatureCacheMismatch",
     "FeatureStore",
+    "GalleryFeatureStore",
     "feature_dtype_name",
     "make_batch_extractor",
     "populate_store",

@@ -69,6 +69,11 @@ def trunk_digest(fe_params, config, image_size):
     plus the extraction-relevant config: cnn name, input image size,
     feature dtype, and the normalize/center toggles that run inside
     ``feature_extraction_apply``.
+
+    ``image_size=None`` means size-agnostic: gallery stores
+    (:class:`GalleryFeatureStore`) hold images of heterogeneous resized
+    shapes, each shard self-describing its own — the digest then pins
+    everything EXCEPT the size.
     """
     import jax
     from flax import serialization
@@ -81,7 +86,10 @@ def trunk_digest(fe_params, config, image_size):
         json.dumps(
             {
                 "cnn": config.feature_extraction_cnn,
-                "image_size": [int(s) for s in image_size],
+                "image_size": (
+                    None if image_size is None
+                    else [int(s) for s in image_size]
+                ),
                 "feature_dtype": feature_dtype_name(config),
                 "normalize_features": bool(config.normalize_features),
                 "center_features": bool(config.center_features),
@@ -260,4 +268,106 @@ class FeatureStore:
         return sum(
             os.path.getsize(self.shard_path(idx, r))
             for r in ("source", "target")
+        )
+
+
+class GalleryFeatureStore:
+    """Path-keyed trunk-feature store for retrieval galleries (InLoc).
+
+    The pair store above is index-keyed against a fixed dataset; a
+    retrieval gallery is the opposite shape: an open-ended set of
+    database images, each revisited by MANY queries (the InLoc shortlist
+    shows every pano to ~tens of queries — the same trunk GFLOPs
+    recomputed per query-pano pair). Here each image's features are one
+    durable shard keyed by a digest of its PATH, under a manifest pinned
+    to the trunk digest (weights + cnn + dtype + normalize/center; image
+    size excluded — gallery images resize per their own aspect, and each
+    shard self-describes its shape). Opening with a different trunk
+    digest raises :class:`FeatureCacheMismatch`: stale features are
+    rejected, never silently matched against.
+
+    Shards use the same durable write/read discipline as the pair store
+    (temp + fsync + atomic rename + sha256 sidecar verified at read), so
+    a killed dump never leaves a torn shard and bitrot is detected.
+    """
+
+    def __init__(self, root, manifest):
+        self.root = os.path.abspath(root)
+        self.manifest = manifest
+
+    @classmethod
+    def create(cls, root, digest, config):
+        manifest = {
+            "version": STORE_VERSION,
+            "kind": "gallery",
+            "digest": str(digest),
+            "cnn": config.feature_extraction_cnn,
+            "feature_dtype": feature_dtype_name(config),
+            "normalize_features": bool(config.normalize_features),
+            "center_features": bool(config.center_features),
+        }
+        np_dtype(manifest["feature_dtype"])  # validates the name
+        durable.durable_write_bytes(
+            os.path.join(os.path.abspath(root), MANIFEST_NAME),
+            json.dumps(manifest, sort_keys=True, indent=1).encode("ascii"),
+        )
+        return cls(root, manifest)
+
+    @classmethod
+    def open_store(cls, root, expected_digest=None):
+        path = os.path.join(os.path.abspath(root), MANIFEST_NAME)
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"no feature-cache manifest at {path}")
+        manifest = json.loads(
+            durable.read_verified_bytes(path).decode("ascii")
+        )
+        if manifest.get("kind") != "gallery":
+            raise FeatureCacheMismatch(
+                f"feature cache at {root} is a "
+                f"{manifest.get('kind', 'pair')!r} store, not a gallery "
+                "store; point --feature-store at its own directory"
+            )
+        if expected_digest is not None and manifest.get("digest") != str(
+            expected_digest
+        ):
+            raise FeatureCacheMismatch(
+                f"gallery feature cache at {root} was extracted under "
+                f"digest {manifest.get('digest')!r}, but the current "
+                f"trunk/config digests to {expected_digest!r} (trunk "
+                "weights, backbone, feature dtype, or normalize/center "
+                "flags changed). Re-extract into a fresh directory — "
+                "matching against stale features would silently produce "
+                "noise."
+            )
+        return cls(root, manifest)
+
+    @classmethod
+    def open_or_create(cls, root, digest, config):
+        """Open a matching store, or create an empty one when absent.
+        An EXISTING manifest with a different digest still raises."""
+        try:
+            return cls.open_store(root, expected_digest=digest)
+        except FileNotFoundError:
+            return cls.create(root, digest, config)
+
+    def shard_path(self, image_path):
+        key = hashlib.sha256(str(image_path).encode("utf-8")).hexdigest()
+        return os.path.join(self.root, f"{key[:32]}.feat")
+
+    def has(self, image_path):
+        return os.path.exists(self.shard_path(image_path))
+
+    def put(self, image_path, features):
+        """Durably write one image's features (idempotent rewrite)."""
+        durable.durable_write_bytes(
+            self.shard_path(image_path),
+            _encode_shard(features, self.manifest["feature_dtype"]),
+        )
+
+    def get(self, image_path):
+        """Read one image's features, digest-verified (raises
+        ``durable.IntegrityError`` on bitrot)."""
+        return _decode_shard(
+            durable.read_verified_bytes(self.shard_path(image_path)),
+            self.manifest["feature_dtype"],
         )

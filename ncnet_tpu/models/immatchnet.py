@@ -65,6 +65,18 @@ class ImMatchNetConfig:
     # (center-tap pass-through + small noise — the basin from which weak
     # training demonstrably improves matching; see init_neigh_consensus).
     nc_init: str = "reference"
+    # Sparse-band neighbourhood consensus (ncnet_tpu.sparse,
+    # arXiv:2004.10566): keep only the top-K B-candidates per A-cell and
+    # run the NC stack with submanifold semantics on that band —
+    # O(K/(hB*wB)) of the dense NC FLOPs. 0 = dense (reference
+    # semantics); K >= hB*wB runs the complete band and must reproduce
+    # the dense path exactly. Incompatible with relocalization configs.
+    nc_topk: int = 0
+    # Band selection: True picks by the symmetric rank min(rank-in-A-row,
+    # rank-in-B-column) so the support is closed under the A/B swap up to
+    # the per-cell capacity (better B-grid coverage for the inverse
+    # readout direction); False is the plain per-A top-K.
+    nc_topk_mutual: bool = True
 
     def replace(self, **kw):
         return dataclasses.replace(self, **kw)
@@ -108,9 +120,26 @@ def match_pipeline(nc_params, config: ImMatchNetConfig, feat_a, feat_b):
     features for the rolled-negative pair (the reference recomputes the
     backbone for the negative pass, train.py:137-138 — with a frozen/deterministic
     backbone the features are identical, so recomputing is pure waste).
+
+    With ``config.nc_topk > 0`` the correlation -> MM -> NC -> MM chain
+    runs on the top-K band (ncnet_tpu.sparse) and the filtered band is
+    densified ONLY here, for the readout consumers — exact zeros
+    off-band, identical to the dense output at ``K = hB*wB``. The
+    training loss bypasses this densification and scores the band
+    directly (train/loss.py).
     """
     dtype = jnp.bfloat16 if config.half_precision else None
     k = config.relocalization_k_size
+    if getattr(config, "nc_topk", 0):
+        from ncnet_tpu.sparse.pipeline import (
+            sparse_corr_to_dense,
+            sparse_match_pipeline,
+        )
+
+        band, indices, grid_b = sparse_match_pipeline(
+            nc_params, config, feat_a, feat_b
+        )
+        return sparse_corr_to_dense(band, indices, grid_b)
     delta4d = None
     if k > 1:
         corr, delta4d = correlation_maxpool4d(feat_a, feat_b, k)

@@ -4,6 +4,13 @@ Every op here mirrors math documented in SURVEY.md §2 against the reference
 (GrumpyZhou/ncnet), but is written channels-last and XLA-first.
 """
 
+from ncnet_tpu.ops.band import (
+    band_coverage,
+    band_gather_neighbors,
+    band_neighbor_pointers,
+    band_to_dense,
+    topk_band,
+)
 from ncnet_tpu.ops.conv4d import conv4d
 from ncnet_tpu.ops.coords import (
     normalize_axis,
@@ -33,6 +40,11 @@ from ncnet_tpu.ops.metrics import pck
 from ncnet_tpu.ops.norm import feature_l2norm
 
 __all__ = [
+    "band_coverage",
+    "band_gather_neighbors",
+    "band_neighbor_pointers",
+    "band_to_dense",
+    "topk_band",
     "conv4d",
     "correlation_3d",
     "correlation_4d",

@@ -1,0 +1,274 @@
+"""Top-K correlation band: selection, neighbour pointers, gathers.
+
+The 4D correlation is overwhelmingly noise: Sparse-NCNet (arXiv:2004.10566)
+keeps only the top-K B-candidates per source cell and filters on that
+support at >10x less compute/memory with equal-or-better PCK. These are the
+band primitives the sparse neighbourhood-consensus path
+(``ncnet_tpu.sparse``) is built from.
+
+Representation — dense-regular, static under jit, NO scatter and NO ragged
+shapes on the hot path:
+
+  values  ``[b, hA, wA, K]``        band entry values
+  indices ``[b, hA, wA, K]`` int32  flattened B-grid index ``iB * wB + jB``,
+                                    SORTED ascending per A-cell
+
+Sorting by B-index makes the band canonical: at ``K = hB*wB`` the band IS
+the dense correlation row in row-major order, which is what makes the
+full-K sparse==dense equivalence contract testable bitwise (the sparse NC
+GEMM then contracts the exact arrays the dense ``'gemm4'`` lowering
+contracts, in the same order — see ``ncnet_tpu/sparse/nc.py``).
+
+Out-of-range semantics are explicit everywhere: every gather in this module
+passes ``mode=`` (the ``unchecked-gather`` lint rule), and neighbour reads
+that fall off the B grid, off the A grid, or off the band resolve to a
+dedicated all-zero null slot — exact zeros, not clamped copies of edge
+values (silent clip semantics would mask band-index bugs).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _ranks_descending(x):
+    """Per-row dense ranks of ``x`` along the last axis (0 = largest).
+
+    Stable: ties rank in index order, so the selection below is
+    deterministic for equal scores.
+    """
+    order = jnp.argsort(-x, axis=-1)
+    return jnp.argsort(order, axis=-1).astype(jnp.int32)
+
+
+def topk_band(scores, k, values_from=None, mutual=False):
+    """Select the per-A-cell top-K band from a dense correlation.
+
+    Args:
+      scores: ``[b, hA, wA, hB, wB]`` selection scores (the RAW
+        correlation in the sparse NC pipeline).
+      k: static band width, ``1 <= k <= hB*wB``. ``k = hB*wB`` keeps
+        everything (the band is complete and the sparse path must equal
+        the dense path).
+      values_from: optional ``[b, hA, wA, hB, wB]`` tensor to read the
+        band VALUES from (default: ``scores``). The sparse pipeline
+        selects on the raw correlation but carries the mutual-matching
+        gated values, mirroring the dense corr -> MM -> NC order.
+      mutual: symmetric/transposed selection. With False the band is the
+        plain per-A top-K of ``scores`` (lax.top_k over the flattened B
+        grid). With True the selection key is the SYMMETRIC rank
+        ``min(rank within the A-row, rank within the B-column)`` — the
+        union of "a picks b" and "b picks a" selections, grown jointly —
+        so the support is closed under the A/B swap up to the per-cell
+        capacity K (rows where the union overflows K drop their worst
+        entries; at ``k = hB*wB`` the band is complete and exactly
+        swap-closed). Ties break by the within-row rank, so the order is
+        total and deterministic.
+
+    Returns:
+      ``(values [b, hA, wA, K], indices int32 [b, hA, wA, K])`` with
+      indices sorted ascending per A-cell.
+    """
+    b, ha, wa, hb, wb = scores.shape
+    nb = hb * wb
+    k = int(k)
+    if not 1 <= k <= nb:
+        raise ValueError(
+            f"band width k={k} must be in [1, hB*wB={nb}] "
+            f"for a {hb}x{wb} B grid"
+        )
+    flat = scores.reshape(b, ha, wa, nb)
+    if mutual:
+        if nb > 46340:  # sqrt(int32 max): the rank key below is min*nb+ra
+            raise ValueError(
+                f"mutual band selection needs nb=hB*wB <= 46340 (int32 "
+                f"rank key), got {nb}; use mutual=False at this grid size"
+            )
+        rank_a = _ranks_descending(flat)  # rank of b within its A-row
+        # rank of a within its B-column: rank along the flattened A axis
+        cols = scores.reshape(b, ha * wa, nb)
+        rank_b = _ranks_descending(jnp.swapaxes(cols, 1, 2))  # [b, nB, nA]
+        rank_b = jnp.swapaxes(rank_b, 1, 2).reshape(b, ha, wa, nb)
+        # primary key: symmetric rank (union growth order); secondary:
+        # the unique within-row rank — a total order, so top_k is
+        # deterministic and reproducible
+        key = jnp.minimum(rank_a, rank_b) * nb + rank_a
+        _, idx = lax.top_k(-key, k)
+    else:
+        _, idx = lax.top_k(flat, k)
+    idx = jnp.sort(idx, axis=-1).astype(jnp.int32)  # canonical band order
+    source = flat if values_from is None else values_from.reshape(
+        b, ha, wa, nb
+    )
+    values = jnp.take_along_axis(
+        source, idx, axis=-1, mode="promise_in_bounds"  # top_k indices
+    )
+    return values, idx
+
+
+def band_to_dense(values, indices, grid_b, fill=0.0):
+    """Expand a band back to the dense ``[b, hA, wA, hB, wB]`` tensor.
+
+    Off-band cells read ``fill`` (0 = the submanifold off-support value;
+    the band scores use ``-inf`` so off-band entries carry no softmax
+    mass). The scatter is static-shaped and runs ONCE at the readout /
+    scoring boundary — the NC stack itself never materializes it. With
+    ``K = hB*wB`` this is an exact (bitwise) inverse of `topk_band`'s
+    flatten: every cell is written exactly once.
+    """
+    b, ha, wa, k = values.shape
+    hb, wb = grid_b
+    na, nb = ha * wa, hb * wb
+    dense = jnp.full((b, na, nb), fill, values.dtype)
+    bi = jnp.arange(b)[:, None, None]
+    ai = jnp.arange(na)[None, :, None]
+    dense = dense.at[bi, ai, indices.reshape(b, na, k)].set(
+        values.reshape(b, na, k)
+    )
+    return dense.reshape(b, ha, wa, hb, wb)
+
+
+def band_coverage(indices, grid_b):
+    """Bool ``[b, hB, wB]``: B-cells referenced by at least one band entry.
+
+    The per-B ("for every B cell, its best A") readout and score
+    directions are only defined on covered cells; uncovered cells are
+    masked out of band scores (at ``K = hB*wB`` everything is covered).
+    """
+    b = indices.shape[0]
+    hb, wb = grid_b
+    covered = jnp.zeros((b, hb * wb), bool)
+    covered = covered.at[
+        jnp.arange(b)[:, None], indices.reshape(b, -1)
+    ].set(True)
+    return covered.reshape(b, hb, wb)
+
+
+def band_neighbor_pointers(indices, grid_b, kernel, swapped=False):
+    """Flat gather pointers from each band entry to its 4D-conv neighbours.
+
+    For band entry ``(a, b)`` and kernel tap ``t = (d1, d2, d3, d4)``
+    (row-major over ``kernel``), the submanifold 4D convolution reads the
+    band value at ``(a + oA(t), b + oB(t))`` — zero when that neighbour
+    is off the A grid, off the B grid, or not on the band. The returned
+    table resolves each read to a slot in the flattened band
+    ``[b, hA*wA*K]`` (plus one trailing all-zero null row at index
+    ``hA*wA*K``), so a layer's whole input gather is ONE
+    ``take_along_axis``:
+
+      ptr ``[b, hA, wA, K, T]`` int32, ``T = k1*k2*k3*k4``.
+
+    ``swapped=False``: ``oA(t) = (d1, d2) - center``, ``oB = (d3, d4) -
+    center`` — the plain pass. ``swapped=True``: the roles invert
+    (``oA = (d3, d4)``, ``oB = (d1, d2)``), which makes
+    ``GEMM(gather(ptr_swapped), w_flat)`` compute the symmetric
+    ``T(net(T(x)))`` term directly on the A-major band — entry ``(a, b)``
+    of the swapped pass reads exactly the taps the dense transposed pass
+    reads at ``(b, a)``, in the same order, so no B-major band
+    representation is ever needed (see ``ncnet_tpu/sparse/nc.py``).
+
+    The support (hence this table) is fixed across NC layers — build once
+    per band per kernel size and reuse. Construction is integer VPU work:
+    per A-tap, a broadcast membership test of each target B-index against
+    the K slots of the neighbouring A-cell's (sorted) band row. The
+    transient comparison tensor is ``[b, hA, wA, K, kB, K]`` — bounded by
+    the A-tap loop; the k^4-tap table itself is the same size as one
+    gathered activation layer input.
+    """
+    k1, k2, k3, k4 = (int(s) for s in kernel)
+    b, ha, wa, kslots = indices.shape
+    hb, wb = grid_b
+    na = ha * wa
+    null = na * kslots  # the all-zero row appended by band_gather_neighbors
+
+    if swapped:
+        # A-offsets range over (k3, k4), B-offsets over (k1, k2); the tap
+        # sequence must stay (d1, d2, d3, d4) row-major so the SAME
+        # flattened kernel pairs with both tables.
+        ka_i, ka_j, kb_i, kb_j = k3, k4, k1, k2
+    else:
+        ka_i, ka_j, kb_i, kb_j = k1, k2, k3, k4
+    pa_i, pa_j = ka_i // 2, ka_j // 2
+    pb_i, pb_j = kb_i // 2, kb_j // 2
+
+    ib = indices // wb  # [b, hA, wA, K]
+    jb = indices % wb
+
+    # B-target indices for every B-offset, shared by all A-taps
+    di_b = jnp.arange(kb_i) - pb_i
+    dj_b = jnp.arange(kb_j) - pb_j
+    tb_i = ib[..., None, None] + di_b[:, None]  # [b,hA,wA,K,kb_i,kb_j]
+    tb_j = jb[..., None, None] + dj_b[None, :]
+    valid_b = (tb_i >= 0) & (tb_i < hb) & (tb_j >= 0) & (tb_j < wb)
+    target = (tb_i * wb + tb_j).reshape(b, ha, wa, kslots, kb_i * kb_j)
+    valid_b = valid_b.reshape(b, ha, wa, kslots, kb_i * kb_j)
+
+    # A-neighbour band rows: pad the index grid with -1 (matches no
+    # target, every target is >= 0 where valid_b holds)
+    idx_pad = jnp.pad(
+        indices, ((0, 0), (pa_i, pa_i), (pa_j, pa_j), (0, 0)),
+        constant_values=-1,
+    )
+    ia = jnp.arange(ha)[:, None]
+    ja = jnp.arange(wa)[None, :]
+
+    chunks = []
+    for da_i in range(ka_i):
+        for da_j in range(ka_j):
+            nbr_rows = idx_pad[:, da_i : da_i + ha, da_j : da_j + wa, :]
+            # membership of each target in the neighbour's sorted row:
+            # [b, hA, wA, K, kB, Kslots] transient, bounded by this loop
+            eq = (
+                target[..., None]
+                == nbr_rows[:, :, :, None, None, :]
+            )
+            found = jnp.any(eq, axis=-1)
+            slot = jnp.argmax(eq, axis=-1).astype(jnp.int32)
+            ni = ia + (da_i - pa_i)
+            nj = ja + (da_j - pa_j)
+            valid_a = (ni >= 0) & (ni < ha) & (nj >= 0) & (nj < wa)
+            base = (ni * wa + nj) * kslots  # flat band row start
+            ptr = jnp.where(
+                found & valid_b & valid_a[None, :, :, None, None],
+                base[None, :, :, None, None] + slot,
+                null,
+            )
+            chunks.append(ptr)  # [b, hA, wA, K, kB]
+    ptr = jnp.stack(chunks, axis=4)  # [b,hA,wA,K, kA, kB]
+    if swapped:
+        # assembled A-offset-major; the tap contract is (d1..d4) row-major
+        # = B-offset-major here, so swap the two tap axes
+        ptr = jnp.swapaxes(ptr, 4, 5)
+    return ptr.reshape(b, ha, wa, kslots, k1 * k2 * k3 * k4)
+
+
+def band_gather_neighbors(x_entries, ptr):
+    """Gather every band entry's conv-window neighbours as one dense block.
+
+    Args:
+      x_entries: ``[b, N, c]`` band activations as a flat entry list
+        (``N = hA*wA*K`` in any entry order — the pointer VALUES address
+        this same order).
+      ptr: ``[b, N, T]`` from `band_neighbor_pointers` (reshaped, and
+        row-permuted/remapped by the caller when the entry order is not
+        the canonical cell-major one — see the swapped symmetric pass in
+        ``ncnet_tpu/sparse/nc.py``).
+
+    Returns:
+      ``[b, N, T*c]`` (tap-major, channel-minor trailing dim — the row
+      layout of ``w.reshape(T*c_in, c_out)``), ready for the one MXU GEMM
+      per NC layer. Off-grid / off-band pointers hit the appended null
+      row and contribute EXACT zeros.
+    """
+    b, n, c = x_entries.shape
+    t = ptr.shape[-1]
+    x_pad = jnp.concatenate(
+        [x_entries, jnp.zeros((b, 1, c), x_entries.dtype)], axis=1
+    )
+    gathered = jnp.take_along_axis(
+        x_pad,
+        ptr.reshape(b, n * t)[..., None],
+        axis=1,
+        mode="promise_in_bounds",  # pointers are clamped to null by build
+    )
+    return gathered.reshape(b, n, t * c)

@@ -36,6 +36,7 @@ CONV4D_IMPLS = (
     "xla", "taps", "scan", "tlc", "btl", "btl2", "btl3", "btl4", "btl5",
     "btl6", "tlcv",
     "tf3", "tf2", "cf", "cfs", "cf1", "cf1s", "ck1", "tk1", "gemm", "gemms",
+    "gemm4",
 )
 
 
@@ -111,7 +112,7 @@ def _banded_weights(w, n_rows, n_cols, offset):
     c = jnp.arange(n_cols)[None, :]
     dl = r - c + offset  # [n_rows, n_cols]
     valid = (dl >= 0) & (dl < kl)
-    t = jnp.take(w, jnp.clip(dl, 0, kl - 1), axis=3)
+    t = jnp.take(w, jnp.clip(dl, 0, kl - 1), axis=3, mode="clip")
     t = jnp.where(valid[None, None, None, :, :, None, None], t, 0)
     # [ki,kj,kk, rows, cols, cin, cout] -> [.., rows*cin, cols*cout]
     t = t.transpose(0, 1, 2, 3, 5, 4, 6)
@@ -892,6 +893,49 @@ def _conv4d_gemms(x, w):
     return jnp.moveaxis(out, 0, 1)
 
 
+def _conv4d_gemm4(x, w):
+    """conv4d as ONE GEMM with ALL ``k^4`` taps gathered into the
+    contraction dim: rows ``[b, i*j*k*l]``, contraction ``k^4 * cin``
+    (tap-major, channel-minor), no epilogue.
+
+    This is the arithmetic mirror of the sparse band path
+    (``ncnet_tpu/sparse/nc.py``) evaluated on the complete band: the
+    gathered operand holds the same values in the same order and the
+    flattened kernel is the same ``[k^4*cin, cout]`` matrix, so at
+    ``K = hB*wB`` the band GEMM and this lowering agree BITWISE in eager
+    mode — the exactness harness of tests/test_sparse.py. As a training
+    impl it is memory-hungry (the gather materializes ``k^4`` shifted
+    copies, vs `_conv4d_gemm`'s ``ki*kl``); use 'gemm'/'gemms' or the
+    tap-folded impls for throughput.
+    """
+    b, i, j, k, l, cin = x.shape
+    ki, kj, kk, kl, _, cout = w.shape
+    pi, pj, pk, pl_ = ki // 2, kj // 2, kk // 2, kl // 2
+    xpad = jnp.pad(
+        x, ((0, 0), (pi, pi), (pj, pj), (pk, pk), (pl_, pl_), (0, 0))
+    )
+    # every slice is reshaped to 3D BEFORE the concat (law 1: >=6D
+    # intermediates draw pathological TPU layouts)
+    cols = jnp.concatenate(
+        [
+            xpad[:, d1 : d1 + i, d2 : d2 + j, d3 : d3 + k, d4 : d4 + l, :]
+            .reshape(b, i * j * k * l, cin)
+            for d1 in range(ki)
+            for d2 in range(kj)
+            for d3 in range(kk)
+            for d4 in range(kl)
+        ],
+        axis=-1,
+    )  # [b, M, k^4*cin], tap-major / channel-minor
+    y = jnp.einsum(
+        "bnf,fo->bno",
+        cols,
+        w.reshape(ki * kj * kk * kl * cin, cout).astype(x.dtype),
+        preferred_element_type=x.dtype,
+    )
+    return y.reshape(b, i, j, k, l, cout)
+
+
 def _flip_transpose(w):
     """Filters of the conv4d input-gradient identity: spatially flipped,
     in/out channels swapped (stride-1 SAME, odd kernels)."""
@@ -1067,6 +1111,22 @@ def _composite_conv4d(fwd_impl, dx_impl, dw_impl=""):
     return f
 
 
+def _add_bias_flat(out, bias):
+    """Bias add on the ``[b, M, c_out]`` flattened view (pure reshapes:
+    elementwise-identical output). The REDUCE SHAPE of the bias gradient
+    follows the shape the add happened on, and XLA's reduction order is
+    factorization-dependent — adding on the flat view gives the bias
+    gradient the same shape as the sparse band path's
+    (``ncnet_tpu/sparse/nc.py``), which keeps the full-K sparse==dense
+    training equivalence bitwise instead of merely ULP-close."""
+    if bias is None:
+        return out
+    b = out.shape[0]
+    cout = out.shape[-1]
+    flat = out.reshape(b, -1, cout) + bias
+    return flat.reshape(out.shape)
+
+
 def conv4d(x, w, bias=None, impl="xla", interpret=None):
     """SAME, stride-1 4D convolution.
 
@@ -1094,6 +1154,10 @@ def conv4d(x, w, bias=None, impl="xla", interpret=None):
         'gemm'/'gemms' ((di, dl) taps gathered into the contraction dim,
         (dj, dk) into output channels: ONE full-lane MXU GEMM, true FLOPs;
         'gemms' is the scanned low-memory variant) |
+        'gemm4' (ALL k^4 taps in the contraction dim, no epilogue — the
+        arithmetic mirror of the sparse band path at full K, kept as the
+        bitwise-equivalence reference; k^4 gather copies make it a
+        memory-hungry training choice) |
         'pallas' (hand-written TPU kernel on the packed layout,
         kernels/conv4d_pallas.py; hypercubic kernels only).
       interpret: for impl='pallas' only — see `conv4d_packed`.
@@ -1119,9 +1183,7 @@ def conv4d(x, w, bias=None, impl="xla", interpret=None):
             )
         parts = impl.split("/")
         out = _composite_conv4d(*parts)(x, w)
-        if bias is not None:
-            out = out + bias
-        return out
+        return _add_bias_flat(out, bias)
     if impl == "xla":
         out = _conv4d_xla(x, w)
     elif impl == "taps":
@@ -1156,8 +1218,8 @@ def conv4d(x, w, bias=None, impl="xla", interpret=None):
         out = _conv4d_gemm(x, w)
     elif impl == "gemms":
         out = _conv4d_gemms(x, w)
+    elif impl == "gemm4":
+        out = _conv4d_gemm4(x, w)
     else:
         raise ValueError(f"unknown conv4d impl: {impl!r}")
-    if bias is not None:
-        out = out + bias
-    return out
+    return _add_bias_flat(out, bias)

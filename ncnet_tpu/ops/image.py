@@ -127,8 +127,8 @@ def resize_bilinear_align_corners(image, out_h, out_w):
         shape = [1] * x.ndim
         shape[axis] = out_n
         frac = frac.reshape(shape)
-        return jnp.take(x, lo, axis=axis) * (1 - frac) + jnp.take(
-            x, hi, axis=axis
+        return jnp.take(x, lo, axis=axis, mode="clip") * (1 - frac) + jnp.take(
+            x, hi, axis=axis, mode="clip"
         ) * frac
 
     image = interp(image, image.ndim - 3, out_h, h)

@@ -1,6 +1,7 @@
 """Soft mutual-nearest-neighbour filtering and 4D max-pooling."""
 
 import jax.numpy as jnp
+import numpy as np
 
 
 def mutual_matching(corr, eps=1e-5):
@@ -37,17 +38,39 @@ def maxpool4d(corr, k_size):
     Returns:
       ``(pooled, (di, dj, dk, dl))``; pooled is
       ``[b, iA/k, jA/k, iB/k, jB/k]``, offsets are int32 of the same shape.
+
+    Implementation note: formulated as a strided-slice max-accumulation
+    over the ``k^4`` within-cell offsets — the same shape the fused
+    `ops.correlation.correlation_maxpool4d` uses — with every
+    intermediate a 5D tensor. The previous blocked formulation built a
+    transposed 9D intermediate, and the repo's measured layout law is
+    that >=6D intermediates draw pathological TPU layouts (4-10x tile
+    padding — bench.py header, benchmarks/PERF.md). Offsets are
+    identical: enumeration runs in ascending combined-offset order with
+    a strict ``>``, so ties keep the FIRST maximum exactly like argmax
+    over the reference's slice enumeration (lib/model.py:177-191).
+    See benchmarks/micro_maxpool.py for the measured comparison.
     """
     k = int(k_size)
     b, d1, d2, d3, d4 = corr.shape
-    blocks = corr.reshape(b, d1 // k, k, d2 // k, k, d3 // k, k, d4 // k, k)
-    # -> [b, d1/k, d2/k, d3/k, d4/k, k, k, k, k]
-    blocks = blocks.transpose(0, 1, 3, 5, 7, 2, 4, 6, 8)
-    flat = blocks.reshape(b, d1 // k, d2 // k, d3 // k, d4 // k, k**4)
-    pooled = jnp.max(flat, axis=-1)
-    idx = jnp.argmax(flat, axis=-1).astype(jnp.int32)
-    dl = idx % k
-    dk = (idx // k) % k
-    dj = (idx // (k * k)) % k
-    di = idx // (k * k * k)
-    return pooled, (di, dj, dk, dl)
+    pooled_shape = (b, d1 // k, d2 // k, d3 // k, d4 // k)
+    neg_inf = (
+        -jnp.inf
+        if jnp.issubdtype(corr.dtype, jnp.floating)
+        else jnp.iinfo(corr.dtype).min
+    )
+    best = jnp.full(pooled_shape, neg_inf, corr.dtype)
+    best_idx = jnp.zeros(pooled_shape, jnp.int32)
+    for combo in range(k**4):
+        di, rem = divmod(combo, k * k * k)
+        dj, rem = divmod(rem, k * k)
+        dk, dl = divmod(rem, k)
+        sub = corr[:, di::k, dj::k, dk::k, dl::k]  # 5D strided slice
+        take = sub > best
+        best = jnp.where(take, sub, best)
+        best_idx = jnp.where(take, np.int32(combo), best_idx)
+    dl = best_idx % k
+    dk = (best_idx // k) % k
+    dj = (best_idx // (k * k)) % k
+    di = best_idx // (k * k * k)
+    return best, (di, dj, dk, dl)

@@ -1,0 +1,38 @@
+"""TPU-native sparse-band neighbourhood consensus (Sparse-NCNet line).
+
+The dense NC stack is O((hA*wA) * (hB*wB) * k^4 * c) — 97.6% of the
+analytic step FLOPs at the PF-Pascal 400px config — yet the 4D
+correlation it filters is overwhelmingly noise (arXiv:2004.10566,
+arXiv:2012.09842). This package keeps only the top-K B-candidates per
+A-cell (a dense-regular band, static shapes under jit, no scatter on the
+hot path) and runs the NC stack with submanifold semantics on that band:
+output support = input support, off-band neighbours read as exact zeros,
+each layer one gathered MXU GEMM — O((hA*wA) * K * k^4 * c) per layer.
+
+Exactness is the design contract: with ``K = hB*wB`` the band is complete
+and the sparse path reproduces the dense path (eager: bitwise against the
+arithmetic-mirror ``conv4d`` lowering ``'gemm4'``; jitted: ULP-allclose)
+— the equivalence harness every smaller K is tested under
+(tests/test_sparse.py).
+
+Enable with ``ImMatchNetConfig(nc_topk=K)`` (0 = dense); training, eval
+readout, and the weak loss all follow the config.
+"""
+
+from ncnet_tpu.sparse.matching import band_mutual_matching
+from ncnet_tpu.sparse.nc import sparse_neigh_consensus_apply
+from ncnet_tpu.sparse.pipeline import (
+    resolve_band_width,
+    sparse_corr_to_dense,
+    sparse_match_pipeline,
+)
+from ncnet_tpu.sparse.score import band_match_score_per_sample
+
+__all__ = [
+    "band_match_score_per_sample",
+    "band_mutual_matching",
+    "resolve_band_width",
+    "sparse_corr_to_dense",
+    "sparse_match_pipeline",
+    "sparse_neigh_consensus_apply",
+]

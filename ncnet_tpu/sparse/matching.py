@@ -1,0 +1,47 @@
+"""Soft mutual-NN filtering on a correlation band.
+
+Band in, band out, submanifold semantics: the gate is computed as if the
+band stood in a dense tensor whose off-band cells are exact zeros — which
+is literally how it is evaluated: scatter the band into the 1-channel
+dense ``[b, nA, nB]`` tensor (the same size the selection's raw
+correlation already materialized — the band's memory/FLOP win is the
+``k^4 * c``-channel NC stack, not this tensor), apply the DENSE
+``ops.matching.mutual_matching``, gather the band entries back.
+
+Routing through the dense op is deliberate: both direction maxima see the
+same off-band zeros the dense semantics prescribe, and forward AND
+backward are the dense op's own (scatter/gather are pure placement), so
+at ``K = hB*wB`` the stage is bitwise-identical to the dense pipeline —
+a segment-max formulation was measured to break the full-K
+gradient-equivalence contract through different max-tie structure in the
+backward (post-ReLU NC outputs carry many exact zeros).
+"""
+
+import jax.numpy as jnp
+
+from ncnet_tpu.ops.band import band_to_dense
+from ncnet_tpu.ops.matching import mutual_matching
+
+
+def band_mutual_matching(values, indices, grid_b, eps=1e-5):
+    """Mutual-matching gate on band values (`ops.matching.mutual_matching`).
+
+    Args:
+      values: ``[b, hA, wA, K]`` band values (post-ReLU NC outputs: the
+        implied off-band zeros are a valid floor for both maxima).
+      indices: ``[b, hA, wA, K]`` int32 sorted B-indices.
+      grid_b: static ``(hB, wB)``.
+
+    Returns:
+      gated band ``[b, hA, wA, K]`` on the same support.
+    """
+    b, ha, wa, k = values.shape
+    hb, wb = grid_b
+    dense = band_to_dense(values, indices, grid_b, fill=0.0)
+    gated = mutual_matching(dense, eps=eps)
+    return jnp.take_along_axis(
+        gated.reshape(b, ha, wa, hb * wb),
+        indices,
+        axis=-1,
+        mode="promise_in_bounds",
+    )

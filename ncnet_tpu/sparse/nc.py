@@ -1,0 +1,187 @@
+"""Submanifold neighbourhood-consensus stack on a top-K correlation band.
+
+Each layer is ONE gathered dense GEMM per pass: gather every band entry's
+``k^4`` conv-window neighbours (off-band/off-grid reads are exact zeros)
+into ``[b, N, k^4*c_in]`` with ``N = hA*wA*K`` and contract with the
+flattened kernel ``[k^4*c_in, c_out]`` — full-width MXU rows, no Toeplitz
+FLOP inflation, analytic FLOPs ``2 * (hA*wA) * K * k^4 * c_in * c_out``
+per layer versus the dense ``(hB*wB)/K``-times-larger count.
+
+Symmetric mode never builds a B-major band REPRESENTATION: restricted to
+the band support, ``T(net(T(x)))`` equals running the same flattened
+kernels over a gather whose taps take the A/B roles swapped
+(`band_neighbor_pointers(swapped=True)`). The swapped pass runs over the
+band entries ENUMERATED B-major (a stable argsort of the band's
+B-indices — pure placement): term-for-term and row-for-row that is
+exactly the dense transposed pass, which is what keeps the full-K eager
+equivalence against the dense ``'gemm4/gemm4'`` / ``symmetric_batch=
+False`` reference bitwise-tight through training (losses AND updated NC
+params), not merely allclose — see tests/test_sparse.py.
+
+The pointer tables depend only on the band indices and each layer's
+kernel size, so they are built once per band (per distinct kernel size)
+and shared by every layer.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
+
+from ncnet_tpu.analysis import sanitizer
+from ncnet_tpu.ops.band import band_gather_neighbors, band_neighbor_pointers
+
+
+def _band_conv_impl(x_entries, w, ptr):
+    """One submanifold conv pass: neighbour gather + one GEMM (no bias)."""
+    cout = w.shape[-1]
+    g = band_gather_neighbors(x_entries, ptr)
+    return jnp.einsum(
+        "bnf,fo->bno",
+        g,
+        w.reshape(-1, cout).astype(x_entries.dtype),
+        preferred_element_type=x_entries.dtype,
+    )
+
+
+@jax.custom_vjp
+def _band_conv(x_entries, w, ptr):
+    """`_band_conv_impl` with a scatter-free custom VJP.
+
+    Autodiff's transpose of the neighbour gather is a scatter-add whose
+    per-destination accumulation order differs from the dense conv
+    transpose (and scatters are the slow path on TPU). On the FIXED band
+    support there is a gather-only identity instead: the cotangent of
+    entry ``e`` sums contributions from entries whose tap window covers
+    ``e`` — exactly a submanifold conv of the output cotangent with the
+    spatially-flipped, channel-transposed kernel over the SAME pointer
+    table (flipping the kernel negates every tap offset; odd kernels
+    only, like the dense composite dx). This keeps the backward
+    scatter-free AND makes it the arithmetic mirror of the dense
+    ``'gemm4/gemm4'`` composite — term-for-term, which is what the
+    full-K bitwise training-equivalence contract of tests/test_sparse.py
+    holds against.
+    """
+    return _band_conv_impl(x_entries, w, ptr)
+
+
+def _band_conv_fwd(x_entries, w, ptr):
+    return _band_conv_impl(x_entries, w, ptr), (x_entries, w, ptr)
+
+
+def _band_conv_bwd(res, gy):
+    x_entries, w, ptr = res
+    if any(int(k) % 2 == 0 for k in w.shape[:4]):
+        # the flipped-kernel dx identity needs symmetric tap offsets
+        # (raise, not assert: must survive python -O)
+        raise ValueError(
+            f"sparse band conv requires odd kernel sizes, got {w.shape[:4]}"
+        )
+    wflip = jnp.flip(w, axis=(0, 1, 2, 3)).transpose(0, 1, 2, 3, 5, 4)
+    dx = _band_conv_impl(gy, wflip.astype(gy.dtype), ptr)
+    dx = dx.astype(x_entries.dtype)
+    # kernel gradient: linear transpose of the forward wrt w (conv is
+    # linear in w) — the gather is recomputed (integer-indexed copy)
+    # rather than saved, and the transpose machinery emits the same
+    # swapped-operand dot the dense composite's does (an explicit
+    # 'bnf,bno->fo' einsum was measured NOT bitwise against it: XLA picks
+    # a different reduction strategy per operand order)
+    transpose_w = jax.linear_transpose(
+        lambda ww: _band_conv_impl(x_entries, ww, ptr), w
+    )
+    (dw,) = transpose_w(gy)
+    return dx, dw, None
+
+
+_band_conv.defvjp(_band_conv_fwd, _band_conv_bwd)
+
+
+def sparse_neigh_consensus_apply(params, values, indices, grid_b,
+                                 symmetric=True):
+    """Filter a correlation band with the learned NC stack.
+
+    Args:
+      params: `init_neigh_consensus` layer list (same params as the dense
+        stack — the sparse path is an inference/training-time
+        reformulation, not a different model).
+      values: ``[b, hA, wA, K]`` band values (no channel axis).
+      indices: ``[b, hA, wA, K]`` int32 sorted B-indices (`topk_band`).
+      grid_b: static ``(hB, wB)`` of the B feature grid.
+      symmetric: reference ``symmetric_mode`` — adds the transposed-pass
+        term via the swapped-tap gather (works for rectangular A/B grids
+        too: nothing is ever transposed, only tap roles).
+
+    Returns:
+      ``[b, hA, wA, K]`` filtered band on the SAME support (submanifold
+      semantics; final layer must have 1 output channel).
+    """
+    dtype = values.dtype
+    b, ha, wa, k = values.shape
+    n = ha * wa * k
+
+    ptr_cache = {}
+
+    def pointers(kernel, swapped):
+        key = (kernel, swapped)
+        if key not in ptr_cache:
+            ptr_cache[key] = band_neighbor_pointers(
+                indices, grid_b, kernel, swapped=swapped
+            ).reshape(b, n, -1)
+        return ptr_cache[key]
+
+    def net(x_entries, ptr_for, tag):
+        xp = x_entries
+        for li, p in enumerate(params):
+            w = p["kernel"]
+            y = _band_conv(xp, w, ptr_for(tuple(w.shape[:4])))
+            # params follow the activation dtype and the bias is added
+            # once, exactly like the dense conv4d layers
+            y = y + p["bias"].astype(dtype)
+            # same save-policy tag as the dense stack: the loss-chunk
+            # remat saves these GEMM outputs and recomputes only the
+            # cheap elementwise rest (train/loss.py)
+            y = checkpoint_name(y, "nc_conv")
+            xp = jax.nn.relu(y)
+            xp = sanitizer.tap(f"nc_layer{li}{tag}", xp)
+        return xp
+
+    x = values.reshape(b, n, 1)
+    out = net(x, lambda kern: pointers(kern, False), "")
+
+    if symmetric:
+        # B-major entry permutation: stable argsort of the B-index, so
+        # ties (same B-cell) keep A-major order — row-for-row the dense
+        # transposed pass's (iB, jB, iA, jA) row-major enumeration. All
+        # pure placement: forward values are unchanged, but GEMM row
+        # order (hence the backward's reduction order) matches dense.
+        bidx = indices.reshape(b, n)
+        perm = jnp.argsort(bidx, axis=-1, stable=True)
+        inv = jnp.argsort(perm, axis=-1, stable=True)
+
+        def ptr_swapped(kernel):
+            ptr = pointers(kernel, True)
+            rows = jnp.take_along_axis(
+                ptr, perm[..., None], axis=1, mode="promise_in_bounds"
+            )
+            # pointer VALUES address the cell-major entry list; remap to
+            # the permuted list (the null slot stays the null slot)
+            remap = jnp.concatenate(
+                [inv.astype(jnp.int32),
+                 jnp.full((b, 1), n, jnp.int32)], axis=1
+            )
+            return jnp.take_along_axis(
+                remap, rows.reshape(b, -1), axis=1,
+                mode="promise_in_bounds",
+            ).reshape(rows.shape)
+
+        x2 = jnp.take_along_axis(
+            x, perm[..., None], axis=1, mode="promise_in_bounds"
+        )
+        out2 = net(x2, ptr_swapped, "_sym")
+        out2 = jnp.take_along_axis(
+            out2, inv[..., None], axis=1, mode="promise_in_bounds"
+        )
+        out = out + out2
+
+    if out.shape[-1] != 1:
+        raise ValueError("last NeighConsensus layer must have 1 output channel")
+    return out[..., 0].reshape(b, ha, wa, k)

@@ -1,0 +1,87 @@
+"""End-to-end sparse-band matching pipeline.
+
+Dense pipeline (models/immatchnet.py):  corr -> MM -> NC -> MM.
+Sparse pipeline:                        corr -> MM -> top-K band ->
+                                        submanifold NC -> band MM.
+
+Selection runs on the RAW correlation (per A-cell `lax.top_k` over the
+flattened B grid; optional symmetric/mutual union), the band VALUES carry
+the mutual-matching-gated correlation — the same tensor the dense NC
+stack consumes, gathered onto the band. Everything downstream of the
+(cheap, 1-channel, O(nA*nB)) correlation runs on the dense-regular band:
+the k^4-channel NC convolutions — 97.6% of analytic step FLOPs at the
+PF-Pascal 400px config — cost O(K/(hB*wB)) of their dense count.
+
+With ``K = hB*wB`` the band is complete and every stage above reproduces
+its dense counterpart exactly (the test harness for all smaller K).
+"""
+
+import jax.numpy as jnp
+
+from ncnet_tpu.analysis import sanitizer
+from ncnet_tpu.ops.band import band_to_dense, topk_band
+from ncnet_tpu.ops.correlation import correlation_4d
+from ncnet_tpu.ops.matching import mutual_matching
+from ncnet_tpu.sparse.matching import band_mutual_matching
+from ncnet_tpu.sparse.nc import sparse_neigh_consensus_apply
+
+
+def resolve_band_width(nc_topk, grid_b):
+    """Effective static band width: ``nc_topk`` clamped to the B-grid size
+    (so sweep scripts can pass one K across image sizes; ``K >= hB*wB``
+    simply runs the complete band)."""
+    nb = int(grid_b[0]) * int(grid_b[1])
+    k = int(nc_topk)
+    if k <= 0:
+        raise ValueError(
+            f"nc_topk={nc_topk}: the sparse pipeline needs a positive "
+            "band width (0 selects the dense path upstream)"
+        )
+    return min(k, nb)
+
+
+def sparse_match_pipeline(nc_params, config, feat_a, feat_b):
+    """Features -> filtered correlation band.
+
+    Returns ``(values, indices, grid_b)``: the post-NC, post-MM band in
+    float32 on the top-K support. Use `sparse_corr_to_dense` for dense
+    readout (`corr_to_matches`), `sparse.score.band_match_score_per_sample`
+    for the weak loss.
+    """
+    if config.relocalization_k_size > 1:
+        raise ValueError(
+            "sparse NC (nc_topk > 0) does not support relocalization "
+            "configs: the 4D max-pool offsets are a dense-readout "
+            "construct (set relocalization_k_size to 0)"
+        )
+    dtype = jnp.bfloat16 if config.half_precision else None
+    corr = correlation_4d(feat_a, feat_b)
+    corr = sanitizer.tap("correlation", corr)
+    gated = sanitizer.tap("mutual_matching_pre", mutual_matching(corr))
+    grid_b = (feat_b.shape[1], feat_b.shape[2])
+    k = resolve_band_width(config.nc_topk, grid_b)
+    values, indices = topk_band(
+        corr, k, values_from=gated,
+        mutual=getattr(config, "nc_topk_mutual", True),
+    )
+    if dtype:
+        values = values.astype(dtype)
+    band = sparse_neigh_consensus_apply(
+        nc_params, values, indices, grid_b,
+        symmetric=config.symmetric_mode,
+    )
+    band = sanitizer.tap("neigh_consensus", band)
+    band = sanitizer.tap(
+        "mutual_matching_post",
+        band_mutual_matching(band, indices, grid_b).astype(jnp.float32),
+    )
+    return band, indices, grid_b
+
+
+def sparse_corr_to_dense(values, indices, grid_b):
+    """Readout densification: the filtered band as a ``[b, hA, wA, hB,
+    wB]`` tensor with exact zeros off-band, consumable by the unchanged
+    dense readout (`ops.matches.corr_to_matches`, the PCK evals, the
+    InLoc dump). One static scatter of the 1-channel output — negligible
+    next to the NC stack the band path avoids."""
+    return band_to_dense(values, indices, grid_b, fill=0.0)

@@ -1,0 +1,78 @@
+"""Weak-supervision match scores on a correlation band.
+
+``band_match_score_per_sample`` is the band variant of
+``train.loss.match_score_per_sample``: scores are computed ON the band —
+off-band cells carry no probability mass (softmax), no L1 mass, and no
+max candidates — and the per-B direction averages over COVERED B-cells
+only (cells no band entry lands on have no defined score; at
+``K = hB*wB`` every cell is covered and both directions reduce to the
+dense score bitwise).
+
+The band is expanded to the masked dense ``[b, nA, nB]`` score tensor at
+this boundary: the expansion is one static scatter of an O(corr)-sized
+1-channel tensor — the same size as the raw correlation the selection
+already materialized, and ~k^4*c times smaller than what the NC stack
+avoids — so the hot path stays sparse while the score math reuses the
+exact dense expression structure (the full-K bitwise contract).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.ops.band import band_coverage, band_to_dense
+
+
+def normalize_scores(x, axis, normalization):
+    """Score normalization shared by the dense and band losses (the
+    reference's softmax/l1/none choice, train.py:110-134)."""
+    if normalization is None or normalization == "none":
+        return x
+    if normalization == "softmax":
+        return jax.nn.softmax(x, axis=axis)
+    if normalization == "l1":
+        return x / (jnp.sum(x, axis=axis, keepdims=True) + 1e-4)
+    raise ValueError(f"unknown score normalization {normalization!r}")
+
+
+def band_match_score_per_sample(values, indices, grid_b,
+                                normalization="softmax"):
+    """Per-sample best normalized match score, both directions averaged.
+
+    Args:
+      values: ``[b, hA, wA, K]`` filtered band (f32, post mutual
+        matching).
+      indices: ``[b, hA, wA, K]`` int32 sorted B-indices.
+      grid_b: static ``(hB, wB)``.
+      normalization: 'softmax' (reference default) | 'l1' | 'none'.
+
+    Returns:
+      ``[b]`` scores, the band counterpart of
+      ``match_score_per_sample(corr, normalization)``.
+    """
+    b, ha, wa, k = values.shape
+    hb, wb = grid_b
+    # softmax needs off-band entries at -inf (zero mass, exp(-inf) == 0
+    # exactly); the additive l1/none statistics need them at 0
+    fill = -jnp.inf if normalization == "softmax" else 0.0
+    dense = band_to_dense(values, indices, grid_b, fill=fill)
+    covered = band_coverage(indices, grid_b)
+
+    b_avec = dense.reshape(b, ha * wa, hb, wb)  # scores over A per B cell
+    a_bvec = dense.reshape(b, ha, wa, hb * wb)  # scores over B per A cell
+    scores_b = jnp.max(normalize_scores(b_avec, 1, normalization), axis=1)
+    scores_a = jnp.max(normalize_scores(a_bvec, 3, normalization), axis=3)
+
+    # every A-cell holds K >= 1 band entries: plain mean. B-cells only
+    # average where covered (an all-(-inf) softmax column is NaN by
+    # construction — masked out here, impossible at full K). The masked
+    # mean is jnp.mean over the zero-filled scores RESCALED by
+    # nB/covered-count: at full coverage the factor is exactly 1.0 (a
+    # bitwise identity — jnp.mean must be called, not decomposed into
+    # sum/n, because XLA's fused mean reduction rounds differently from
+    # a standalone reduce_sum followed by a div, which was measured to
+    # break the full-K bitwise contract by 1 ulp).
+    count = jnp.sum(covered, axis=(1, 2)).astype(scores_b.dtype)
+    nb_total = jnp.asarray(float(hb * wb), scores_b.dtype)
+    scores_b = jnp.where(covered, scores_b, jnp.zeros((), scores_b.dtype))
+    mean_b = jnp.mean(scores_b, axis=(1, 2)) * (nb_total / count)  # nclint: disable=unguarded-division -- count >= 1 by construction (K >= 1 band entries per A-cell always cover at least one B-cell), and an epsilon would break the exact-1.0 full-coverage factor
+    return (jnp.mean(scores_a, axis=(1, 2)) + mean_b) / 2

@@ -17,16 +17,8 @@ from jax import lax
 
 from ncnet_tpu.analysis import sanitizer
 from ncnet_tpu.models.immatchnet import extract_features, match_pipeline
-
-
-def _normalize(x, axis, normalization):
-    if normalization is None or normalization == "none":
-        return x
-    if normalization == "softmax":
-        return jax.nn.softmax(x, axis=axis)
-    if normalization == "l1":
-        return x / (jnp.sum(x, axis=axis, keepdims=True) + 1e-4)
-    raise ValueError(f"unknown score normalization {normalization!r}")
+from ncnet_tpu.sparse.score import band_match_score_per_sample
+from ncnet_tpu.sparse.score import normalize_scores as _normalize
 
 
 def match_score_per_sample(corr, normalization="softmax"):
@@ -118,17 +110,43 @@ def weak_loss_core(nc_params, config, feat_a, feat_b, normalization="softmax"):
         )
     feat_a_neg = jnp.roll(feat_a, -1, axis=0)
 
-    def pair_scores(fa, fb, fan):
-        corr_pos = match_pipeline(nc_params, config, fa, fb)
-        corr_neg = match_pipeline(nc_params, config, fan, fb)
-        return (
-            sanitizer.tap(
-                "score_pos", match_score_per_sample(corr_pos, normalization)
-            ),
-            sanitizer.tap(
-                "score_neg", match_score_per_sample(corr_neg, normalization)
-            ),
-        )
+    if getattr(config, "nc_topk", 0):
+        # sparse-band path (ncnet_tpu.sparse): positives AND negatives are
+        # scored on each pair's own top-K band — the NC stack never sees
+        # the dense correlation. The chunking/remat machinery below wraps
+        # pair_scores unchanged; the 'nc_conv' save-policy tags are set by
+        # the sparse stack exactly like the dense one.
+        from ncnet_tpu.sparse.pipeline import sparse_match_pipeline
+
+        def _band_score(fa, fb):
+            band, indices, grid_b = sparse_match_pipeline(
+                nc_params, config, fa, fb
+            )
+            return band_match_score_per_sample(
+                band, indices, grid_b, normalization
+            )
+
+        def pair_scores(fa, fb, fan):
+            return (
+                sanitizer.tap("score_pos", _band_score(fa, fb)),
+                sanitizer.tap("score_neg", _band_score(fan, fb)),
+            )
+
+    else:
+
+        def pair_scores(fa, fb, fan):
+            corr_pos = match_pipeline(nc_params, config, fa, fb)
+            corr_neg = match_pipeline(nc_params, config, fan, fb)
+            return (
+                sanitizer.tap(
+                    "score_pos",
+                    match_score_per_sample(corr_pos, normalization),
+                ),
+                sanitizer.tap(
+                    "score_neg",
+                    match_score_per_sample(corr_neg, normalization),
+                ),
+            )
 
     chunk = getattr(config, "loss_chunk", 0) or 0
     b = feat_a.shape[0]

@@ -116,6 +116,28 @@ def create_train_state(params, optimizer, train_fe=False, step=0,
     return TrainState(params=params, opt_state=opt_state, step=step)
 
 
+def check_sparse_config(config):
+    """Validate the sparse-band (nc_topk) settings before any tracing.
+
+    A negative band width is always a bug, and relocalization configs
+    have no band formulation (the 4D max-pool offsets are dense-readout
+    constructs) — both would otherwise surface deep inside jit tracing of
+    the first step."""
+    nc_topk = getattr(config, "nc_topk", 0)
+    if nc_topk < 0:
+        raise ValueError(
+            f"nc_topk={nc_topk} is negative; use 0 for the dense path or "
+            "a positive top-K band width (ncnet_tpu.sparse)"
+        )
+    if nc_topk and config.relocalization_k_size > 1:
+        raise ValueError(
+            f"nc_topk={nc_topk} with relocalization_k_size="
+            f"{config.relocalization_k_size}: the sparse band path does "
+            "not support relocalization (train with "
+            "relocalization_k_size=0, as the reference does)"
+        )
+
+
 def check_from_features_frozen(train_fe, fe_finetune_blocks):
     """The feature cache is only correct for a FULLY frozen trunk: any
     trunk training makes the cached features stale after one optimizer
@@ -147,6 +169,7 @@ def make_train_step(
     the gradient all-reduce automatically; no hand-written collectives
     needed.
     """
+    check_sparse_config(config)
     if from_features:
         check_from_features_frozen(train_fe, fe_finetune_blocks)
     loss_impl = weak_loss_from_features if from_features else weak_loss
@@ -181,6 +204,7 @@ def make_eval_step(config, normalization="softmax", from_features=False):
     ``from_features=True`` evaluates from cached trunk features
     (``source_features``/``target_features`` batches) with zero backbone
     ops — same math, the trunk forward simply never runs."""
+    check_sparse_config(config)
     loss_impl = weak_loss_from_features if from_features else weak_loss
 
     def eval_fn(params, batch):

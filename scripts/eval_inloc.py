@@ -60,6 +60,21 @@ def main():
                         "original sizes). The upscale check is area-"
                         "based and assumes the aspect-preserving resize "
                         "rule (see eval/inloc.py:load_and_preprocess)")
+    p.add_argument("--feature-store", type=str, default=None,
+                   dest="feature_store", metavar="DIR",
+                   help="gallery feature store "
+                        "(ncnet_tpu.features.GalleryFeatureStore): cache "
+                        "database-pano trunk features in DIR, keyed by "
+                        "image path under a trunk-weights digest — each "
+                        "pano's backbone forward runs once EVER (across "
+                        "queries and dump restarts) instead of once per "
+                        "query-pano pair; the query trunk runs once per "
+                        "query. A store extracted under different trunk "
+                        "weights/config is rejected (digest mismatch), "
+                        "never silently matched against. Incompatible "
+                        "with --spatial_shards/--device_preprocess/"
+                        "--device_resize (the store path has its own "
+                        "host pipeline)")
     p.add_argument("--spatial_shards", type=int, default=0,
                    help="shard the correlation pipeline over this many "
                         "devices ('spatial' mesh axis) for grids beyond "
@@ -70,6 +85,13 @@ def main():
         p.error("--device_resize requires --device_preprocess")
     if args.device_resize is None:
         args.device_resize = args.device_preprocess
+    if args.feature_store:
+        if args.spatial_shards > 1:
+            p.error("--feature-store is incompatible with --spatial_shards")
+        # the store path ships features, not images: the uint8/device
+        # resize transfer engineering does not apply there
+        args.device_preprocess = False
+        args.device_resize = False
 
     if args.checkpoint.endswith((".pth.tar", ".pth")):
         from ncnet_tpu.utils.convert_torch import convert_checkpoint
@@ -145,6 +167,7 @@ def main():
         softmax=args.softmax,
         device_preprocess=args.device_preprocess,
         device_resize=args.device_resize,
+        feature_store_dir=args.feature_store,
     )
 
 

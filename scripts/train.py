@@ -157,6 +157,23 @@ def main():
                         "measured NEGATIVE results — valid but slower on "
                         "TPU. Default: the measured-best mix for 3-layer "
                         "NC configs, 'tlc' otherwise (see ops/conv4d.py)")
+    p.add_argument("--nc_topk", type=int, default=None, metavar="K",
+                   help="sparse-band neighbourhood consensus "
+                        "(ncnet_tpu.sparse, arXiv:2004.10566): keep the "
+                        "top-K B-candidates per A-cell and train the NC "
+                        "stack on that band — analytic NC FLOPs drop by "
+                        "(grid^2)/K at equal-or-better PCK for moderate "
+                        "K (see README 'Sparse neighbourhood "
+                        "consensus'). 0 = dense; K >= grid^2 is exactly "
+                        "the dense math. Unset keeps a resumed "
+                        "checkpoint's recorded value. Incompatible with "
+                        "relocalization configs")
+    p.add_argument("--nc_topk_mutual", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="with --nc_topk: symmetric/mutual band selection "
+                        "(swap-closed up to capacity, better B-grid "
+                        "coverage; the default) vs plain per-A top-K "
+                        "(--no-nc_topk_mutual)")
     p.add_argument("--loss_chunk", type=int, default=None,
                    help="run the correlation->NC->score loss over sample "
                         "chunks of this size (0 = whole batch; when "
@@ -262,6 +279,9 @@ def main():
             or default_impl(len(config.ncons_channels)),
             loss_chunk=chunk, nc_remat=chunk == 0,
             loss_chunk_remat=bool(args.chunk_remat),
+            nc_topk=args.nc_topk or 0,
+            nc_topk_mutual=(True if args.nc_topk_mutual is None
+                            else args.nc_topk_mutual),
         )
         print(f"initialized from reference checkpoint {args.checkpoint} "
               "(weights-only: torch optimizer state is not portable)")
@@ -282,6 +302,12 @@ def main():
         if args.chunk_remat is not None:  # override in EITHER direction;
             # unset keeps the checkpoint's recorded value
             config = config.replace(loss_chunk_remat=args.chunk_remat)
+        if args.nc_topk is not None:  # sparse band: override in either
+            # direction; unset keeps the checkpoint's recorded value (the
+            # NC params are the same model either way)
+            config = config.replace(nc_topk=args.nc_topk)
+        if args.nc_topk_mutual is not None:
+            config = config.replace(nc_topk_mutual=args.nc_topk_mutual)
         # the checkpoint records WHICH params were training (the opt-state
         # pytree shape depends on it); default flags adopt its mode, an
         # explicit different mode restarts the optimizer
@@ -337,6 +363,9 @@ def main():
             # chunk remat is off by default since round 4 (PERF.md)
             nc_remat=not args.loss_chunk,
             loss_chunk_remat=bool(args.chunk_remat),
+            nc_topk=args.nc_topk or 0,
+            nc_topk_mutual=(True if args.nc_topk_mutual is None
+                            else args.nc_topk_mutual),
         )
         params = init_immatchnet(jax.random.PRNGKey(args.seed), config)
 

@@ -350,6 +350,73 @@ def test_non_atomic_artifact_write_exempts_tests():
     assert findings_for(BAD_ARTIFACT_WRITE, path="tests/test_ck.py") == []
 
 
+# --- unchecked-gather -------------------------------------------------------
+
+
+BAD_GATHER_TAKE = """
+import jax.numpy as jnp
+
+def pick(values, idx):
+    return jnp.take(values, idx, axis=1)
+"""
+
+BAD_GATHER_TAL = """
+import jax.numpy as jnp
+
+def pick(values, idx):
+    return jnp.take_along_axis(values, idx, axis=-1)
+"""
+
+BAD_GATHER_AT_GET = """
+def pick(values, idx):
+    return values.at[idx].get()
+"""
+
+CLEAN_GATHER = """
+import jax.numpy as jnp
+
+def pick(values, idx):
+    a = jnp.take(values, idx, axis=1, mode="fill", fill_value=0.0)
+    b = jnp.take_along_axis(values, idx, axis=-1, mode="promise_in_bounds")
+    c = values.at[idx].get(mode="clip")
+    d = values.at[idx].set(0.0)  # writes have their own defaults; not a read
+    return a + b + c + d
+"""
+
+
+def test_unchecked_gather_take_bad():
+    fs = findings_for(BAD_GATHER_TAKE, only="unchecked-gather")
+    assert len(fs) == 1 and fs[0].line == 5
+    assert "mode" in fs[0].message
+
+
+def test_unchecked_gather_take_along_axis_bad():
+    fs = findings_for(BAD_GATHER_TAL, only="unchecked-gather")
+    assert len(fs) == 1
+
+
+def test_unchecked_gather_at_get_bad():
+    fs = findings_for(BAD_GATHER_AT_GET, only="unchecked-gather")
+    assert len(fs) == 1
+    assert ".at[...].get()" in fs[0].message
+
+
+def test_unchecked_gather_clean():
+    assert findings_for(CLEAN_GATHER, only="unchecked-gather") == []
+
+
+def test_unchecked_gather_respects_import_alias():
+    """`numpy.take` (host numpy) raises on OOB by default — only the jnp
+    entry points with silent-clamp jit semantics are in scope."""
+    src = """
+import numpy as np
+
+def pick(values, idx):
+    return np.take(values, idx, axis=1)
+"""
+    assert findings_for(src, only="unchecked-gather") == []
+
+
 # --- mutable-default-arg ----------------------------------------------------
 
 

@@ -449,3 +449,63 @@ def test_dump_matches_device_resize_equivalent(tiny, tmp_path):
     rows_b = {tuple(np.round(r[:4], 6)) for r in b[0, 0] if np.any(r)}
     overlap = len(rows_a & rows_b) / max(len(rows_a), 1)
     assert overlap > 0.9, (overlap, len(rows_a), len(rows_b))
+
+
+def test_dump_matches_feature_store_matches_image_path(tiny, tmp_path):
+    """The gallery-feature-store dump (ROADMAP InLoc open item) must
+    produce the SAME .mat matches as the image-path dump — the store only
+    moves the trunk forward out of the per-pair loop — and a second run
+    must serve every pano from the store (zero trunk reruns), enforced
+    here by deleting the pano images before the rerun."""
+    import os
+
+    from PIL import Image
+    from scipy.io import loadmat
+
+    from ncnet_tpu.eval.inloc import dump_matches
+    from ncnet_tpu.features import FeatureCacheMismatch, GalleryFeatureStore
+
+    rng = np.random.RandomState(3)
+    qdir, pdir = tmp_path / "query", tmp_path / "pano"
+    qdir.mkdir()
+    pdir.mkdir()
+    for d, name in ((qdir, "q0.png"), (pdir, "p0.png"), (pdir, "p1.png")):
+        Image.fromarray(
+            rng.randint(0, 255, (80, 60, 3), np.uint8)
+        ).save(d / name)
+    shortlist = tmp_path / "shortlist.mat"
+    write_shortlist(shortlist, [("q0.png", ["p0.png", "p1.png"])])
+
+    cfg = TINY.replace(relocalization_k_size=2)
+    common = dict(
+        shortlist_path=str(shortlist), query_path=str(qdir),
+        pano_path=str(pdir), image_size=128, n_queries=1, n_panos=2,
+        verbose=False,
+    )
+    dump_matches(tiny, cfg, output_dir=str(tmp_path / "img"), **common)
+    store_dir = tmp_path / "gallery"
+    dump_matches(
+        tiny, cfg, output_dir=str(tmp_path / "st"),
+        feature_store_dir=str(store_dir), **common,
+    )
+    img = loadmat(tmp_path / "img" / "1.mat")["matches"]
+    st = loadmat(tmp_path / "st" / "1.mat")["matches"]
+    np.testing.assert_allclose(st, img, rtol=1e-5, atol=1e-6)
+
+    # rerun from the populated store with the pano IMAGES GONE: every
+    # pano must come from the durable shards
+    os.unlink(pdir / "p0.png")
+    os.unlink(pdir / "p1.png")
+    dump_matches(
+        tiny, cfg, output_dir=str(tmp_path / "st2"),
+        feature_store_dir=str(store_dir), **common,
+    )
+    st2 = loadmat(tmp_path / "st2" / "1.mat")["matches"]
+    np.testing.assert_allclose(st2, st, rtol=0, atol=0)
+
+    # a store extracted under a DIFFERENT trunk digest is rejected, never
+    # silently matched against
+    with pytest.raises(FeatureCacheMismatch):
+        GalleryFeatureStore.open_store(
+            str(store_dir), expected_digest="not-the-trunk"
+        )

@@ -1,0 +1,391 @@
+"""Sparse-band neighbourhood consensus (ncnet_tpu.sparse).
+
+The design contract under test: with ``K = hB*wB`` the band is complete
+and the sparse path must reproduce the dense path — in EAGER mode
+bitwise-tight (forward, losses, and the NC params updated by 3 training
+steps) against the dense reference whose lowering is the arithmetic
+mirror of the band GEMMs (``conv4d_impl='gemm4/gemm4'``,
+``symmetric_batch=False``), and ULP-allclose under jit and against the
+default 'xla' lowering. That equivalence is the harness every smaller K
+rides on: partial-K semantics (off-band = exact zeros) are exercised by
+the edge-gather, selection, and PCK-sweep tests.
+"""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.models.immatchnet import (
+    ImMatchNetConfig,
+    init_immatchnet,
+    match_pipeline,
+)
+from ncnet_tpu.ops.band import (
+    band_gather_neighbors,
+    band_neighbor_pointers,
+    band_to_dense,
+    topk_band,
+)
+from ncnet_tpu.train.loss import weak_loss_core
+from ncnet_tpu.train.step import check_sparse_config
+
+BASE = dict(ncons_kernel_sizes=(3, 3), ncons_channels=(4, 1))
+#: dense reference whose conv lowering + bias placement mirror the band
+#: GEMMs term-for-term (see ncnet_tpu/sparse/nc.py) — the bitwise anchor
+DENSE_MIRROR = ImMatchNetConfig(
+    conv4d_impl="gemm4/gemm4", symmetric_batch=False, **BASE
+)
+
+
+def _feats(rng, b, h, w, c=7):
+    return (
+        jnp.asarray(rng.randn(b, h, w, c).astype(np.float32)),
+        jnp.asarray(rng.randn(b, h, w, c).astype(np.float32)),
+    )
+
+
+def _train3(cfg, params, fa, fb):
+    nc = params["neigh_consensus"]
+    opt = optax.adam(5e-4)
+    st = opt.init(nc)
+    losses = []
+    for _ in range(3):
+        loss, g = jax.value_and_grad(
+            lambda p: weak_loss_core(p, cfg, fa, fb)
+        )(nc)
+        up, st = opt.update(g, st, nc)
+        nc = optax.apply_updates(nc, up)
+        losses.append(np.asarray(loss))
+    return losses, nc
+
+
+# --- full-K equivalence: the exactness contract ------------------------------
+
+
+def test_full_k_forward_bitwise_eager():
+    rng = np.random.RandomState(0)
+    fa, fb = _feats(rng, 2, 5, 5)
+    params = init_immatchnet(jax.random.PRNGKey(0), DENSE_MIRROR)
+    nc = params["neigh_consensus"]
+    sparse = DENSE_MIRROR.replace(nc_topk=25)
+    out_d = np.asarray(match_pipeline(nc, DENSE_MIRROR, fa, fb))
+    out_s = np.asarray(match_pipeline(nc, sparse, fa, fb))
+    np.testing.assert_array_equal(out_d, out_s)
+
+
+def test_full_k_forward_allclose_vs_default_impl():
+    """The mirror impl is itself allclose to the default dense lowering,
+    so full-K sparse == any dense lowering at float tolerance."""
+    rng = np.random.RandomState(1)
+    fa, fb = _feats(rng, 2, 5, 6)
+    cfg_xla = ImMatchNetConfig(**BASE)
+    params = init_immatchnet(jax.random.PRNGKey(1), cfg_xla)
+    nc = params["neigh_consensus"]
+    out_x = np.asarray(match_pipeline(nc, cfg_xla, fa, fb))
+    out_s = np.asarray(
+        match_pipeline(nc, cfg_xla.replace(nc_topk=30), fa, fb)
+    )
+    np.testing.assert_allclose(out_s, out_x, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("symmetric", [False, True])
+def test_full_k_three_training_steps_bitwise_eager(symmetric):
+    """Losses AND updated NC params bitwise over 3 eager Adam steps —
+    gradients through band gather/GEMM, band MM, and band scores are the
+    exact mirror of the dense backward."""
+    rng = np.random.RandomState(2)
+    fa, fb = _feats(rng, 3, 5, 5)
+    cfg_d = DENSE_MIRROR.replace(symmetric_mode=symmetric)
+    cfg_s = cfg_d.replace(nc_topk=25)
+    params = init_immatchnet(jax.random.PRNGKey(2), cfg_d)
+    losses_d, nc_d = _train3(cfg_d, params, fa, fb)
+    losses_s, nc_s = _train3(cfg_s, params, fa, fb)
+    for ld, ls in zip(losses_d, losses_s):
+        assert ld.tobytes() == ls.tobytes()
+    for leaf_d, leaf_s in zip(jax.tree.leaves(nc_d), jax.tree.leaves(nc_s)):
+        np.testing.assert_array_equal(np.asarray(leaf_d), np.asarray(leaf_s))
+
+
+def test_full_k_loss_and_grads_jitted_allclose():
+    rng = np.random.RandomState(3)
+    fa, fb = _feats(rng, 3, 5, 5)
+    cfg_d = ImMatchNetConfig(**BASE)  # default lowering, jitted
+    cfg_s = cfg_d.replace(nc_topk=25)
+    params = init_immatchnet(jax.random.PRNGKey(3), cfg_d)
+    nc = params["neigh_consensus"]
+
+    def lg(cfg):
+        f = jax.jit(
+            jax.value_and_grad(lambda p: weak_loss_core(p, cfg, fa, fb))
+        )
+        return f(nc)
+
+    ld, gd = lg(cfg_d)
+    ls, gs = lg(cfg_s)
+    np.testing.assert_allclose(float(ls), float(ld), rtol=1e-6, atol=1e-7)
+    for a, b in zip(jax.tree.leaves(gd), jax.tree.leaves(gs)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_full_k_equivalence_rectangular_grids():
+    """Symmetric mode on RECTANGULAR A/B grids: the dense path must run
+    its sequential fallback; the band path handles it natively (taps
+    swap roles, nothing is transposed)."""
+    rng = np.random.RandomState(4)
+    fa = jnp.asarray(rng.randn(2, 6, 5, 7).astype(np.float32))
+    fb = jnp.asarray(rng.randn(2, 4, 7, 7).astype(np.float32))
+    params = init_immatchnet(jax.random.PRNGKey(4), DENSE_MIRROR)
+    nc = params["neigh_consensus"]
+    out_d = np.asarray(match_pipeline(nc, DENSE_MIRROR, fa, fb))
+    out_s = np.asarray(
+        match_pipeline(nc, DENSE_MIRROR.replace(nc_topk=28), fa, fb)
+    )
+    np.testing.assert_array_equal(out_d, out_s)
+
+
+def test_full_k_chunked_loss_matches_dense_chunked():
+    cfg_d = DENSE_MIRROR.replace(loss_chunk=2, loss_chunk_remat=True)
+    cfg_s = cfg_d.replace(nc_topk=25)
+    rng = np.random.RandomState(5)
+    fa, fb = _feats(rng, 4, 5, 5)
+    params = init_immatchnet(jax.random.PRNGKey(5), cfg_d)
+    nc = params["neigh_consensus"]
+    ld = weak_loss_core(nc, cfg_d, fa, fb)
+    ls = weak_loss_core(nc, cfg_s, fa, fb)
+    assert np.asarray(ld).tobytes() == np.asarray(ls).tobytes()
+
+
+# --- band selection ----------------------------------------------------------
+
+
+def _numpy_mutual_band(corr, k):
+    """Golden numpy reimplementation of the mutual selection rule:
+    per-A-cell top-K by the key ``min(rank_in_row, rank_in_col) * nB +
+    rank_in_row`` ascending, indices sorted ascending."""
+    b, ha, wa, hb, wb = corr.shape
+    nb = hb * wb
+    flat = corr.reshape(b, ha * wa, nb)
+    out = np.zeros((b, ha * wa, k), np.int32)
+    for bi in range(b):
+        m = flat[bi]
+        order_a = np.argsort(-m, axis=1, kind="stable")
+        rank_a = np.argsort(order_a, axis=1, kind="stable")
+        order_b = np.argsort(-m, axis=0, kind="stable")
+        rank_b = np.argsort(order_b, axis=0, kind="stable")
+        key = np.minimum(rank_a, rank_b) * nb + rank_a
+        sel = np.argsort(key, axis=1, kind="stable")[:, :k]
+        out[bi] = np.sort(sel, axis=1)
+    return out.reshape(b, ha, wa, k)
+
+
+def test_topk_band_plain_matches_numpy():
+    rng = np.random.RandomState(6)
+    corr = rng.randn(2, 3, 4, 3, 5).astype(np.float32)
+    k = 7
+    vals, idx = topk_band(jnp.asarray(corr), k, mutual=False)
+    flat = corr.reshape(2, 3, 4, 15)
+    want_idx = np.sort(np.argsort(-flat, axis=-1)[..., :k], axis=-1)
+    np.testing.assert_array_equal(np.asarray(idx), want_idx)
+    want_vals = np.take_along_axis(flat, want_idx, axis=-1)
+    np.testing.assert_array_equal(np.asarray(vals), want_vals)
+
+
+def test_topk_band_mutual_matches_numpy_golden():
+    rng = np.random.RandomState(7)
+    corr = rng.randn(2, 4, 4, 4, 4).astype(np.float32)
+    for k in (3, 8, 16):
+        _, idx = topk_band(jnp.asarray(corr), k, mutual=True)
+        np.testing.assert_array_equal(
+            np.asarray(idx), _numpy_mutual_band(corr, k)
+        )
+
+
+def test_mutual_band_selection_key_is_swap_symmetric():
+    """The PRIMARY selection key min(rank-in-row, rank-in-col) values an
+    entry identically from both sides of the swap (the 'mutual union'
+    growth order); per-row capacity is the only asymmetry. Checked via
+    the guaranteed consequences: every row argmax AND (here, where
+    capacity suffices) every column argmax is on the band, and B-grid
+    coverage dominates the plain selection's."""
+    rng = np.random.RandomState(8)
+    corr = rng.randn(2, 5, 5, 5, 5).astype(np.float32)
+    k = 12
+    _, idx_mut = topk_band(jnp.asarray(corr), k, mutual=True)
+    _, idx_plain = topk_band(jnp.asarray(corr), k, mutual=False)
+    flat = corr.reshape(2, 25, 25)
+    idx_mut = np.asarray(idx_mut).reshape(2, 25, k)
+    idx_plain = np.asarray(idx_plain).reshape(2, 25, k)
+    for bi in range(2):
+        # row argmax always selected (its key is the global minimum 0)
+        row_best = np.argmax(flat[bi], axis=1)
+        for a in range(25):
+            assert row_best[a] in idx_mut[bi, a]
+        # column argmax selected from the B side at this capacity/seed
+        col_best = np.argmax(flat[bi], axis=0)
+        for b_cell in range(25):
+            assert b_cell in idx_mut[bi, col_best[b_cell]]
+        cov_mut = len(set(idx_mut[bi].ravel().tolist()))
+        cov_plain = len(set(idx_plain[bi].ravel().tolist()))
+        assert cov_mut >= cov_plain
+        assert cov_mut == 25  # full B-grid coverage at K=12, this seed
+
+
+def test_band_to_dense_roundtrip_full_k():
+    rng = np.random.RandomState(9)
+    corr = rng.randn(2, 3, 3, 3, 3).astype(np.float32)
+    vals, idx = topk_band(jnp.asarray(corr), 9)
+    dense = band_to_dense(vals, idx, (3, 3))
+    np.testing.assert_array_equal(np.asarray(dense), corr)
+
+
+# --- out-of-band / edge gather semantics -------------------------------------
+
+
+def test_edge_gather_exact_zeros():
+    """Neighbour reads that fall off the A grid, off the B grid, or off
+    the band must contribute EXACT zeros (not clamped copies — silent
+    clip would mask pointer bugs)."""
+    b, h, w = 1, 3, 3
+    nb = 9
+    corr = jnp.asarray(np.random.RandomState(10).rand(b, h, w, h, w) + 1.0)
+    vals, idx = topk_band(corr, nb)  # complete band, all values >= 1
+    ptr = band_neighbor_pointers(idx, (h, w), (3, 3, 3, 3))
+    n = h * w * nb
+    g = np.asarray(
+        band_gather_neighbors(
+            vals.astype(jnp.float32).reshape(b, n, 1), ptr.reshape(b, n, -1)
+        )
+    ).reshape(b, h, w, nb, 81)
+
+    corr_np = np.asarray(corr)
+    taps = [
+        (d1 - 1, d2 - 1, d3 - 1, d4 - 1)
+        for d1 in range(3) for d2 in range(3)
+        for d3 in range(3) for d4 in range(3)
+    ]
+    for ia in range(h):
+        for ja in range(w):
+            for bidx in range(nb):
+                ib, jb = divmod(bidx, w)
+                for t, (da, dja, dk, dl) in enumerate(taps):
+                    na_i, na_j = ia + da, ja + dja
+                    tb_i, tb_j = ib + dk, jb + dl
+                    on_grid = (
+                        0 <= na_i < h and 0 <= na_j < w
+                        and 0 <= tb_i < h and 0 <= tb_j < w
+                    )
+                    got = g[0, ia, ja, bidx, t]
+                    if on_grid:
+                        assert got == corr_np[0, na_i, na_j, tb_i, tb_j]
+                    else:
+                        # exact zero, and provably not a clamped read:
+                        # every on-band value is >= 1
+                        assert got == 0.0
+
+
+def test_partial_band_off_band_reads_are_zero():
+    """K=1 band on a 3x3 grid: each A-cell holds only its argmax; any
+    neighbour tap pointing at a B-index another cell did NOT select must
+    read exact zero."""
+    rng = np.random.RandomState(11)
+    corr = jnp.asarray(rng.rand(1, 3, 3, 3, 3).astype(np.float32) + 1.0)
+    vals, idx = topk_band(corr, 1)
+    ptr = band_neighbor_pointers(idx, (3, 3), (3, 3, 3, 3))
+    n = 9
+    g = np.asarray(
+        band_gather_neighbors(
+            vals.reshape(1, n, 1), ptr.reshape(1, n, -1)
+        )
+    )
+    vals_np = np.asarray(vals).ravel()
+    # every gathered value is either an exact on-band value or exact 0
+    on_band = set(vals_np.tolist())
+    for v in np.unique(g):
+        assert v == 0.0 or v in on_band
+
+
+# --- PCK vs K ----------------------------------------------------------------
+
+
+def test_synthetic_pck_vs_k_sweep():
+    """Synthetic-transfer PCK over the band-width sweep, on the same
+    pretrained-free setup as the committed synthetic proofs (patch16
+    trunk + identity NC init, scripts/synthetic_convergence.py): the
+    complete band must equal dense EXACTLY (the sweep's sanity anchor),
+    and every partial-K PCK must stay within the reference band around
+    dense — on this construction small K acts as a correlation denoiser
+    and measures ABOVE dense (arXiv:2004.10566's equal-or-better
+    regime), so the monotone K-sweep contract is 'complete band == dense
+    and no collapse below it', not naive growth in K."""
+    from ncnet_tpu.data.pairs import SyntheticPairDataset
+    from ncnet_tpu.eval.synthetic import (
+        evaluate_synthetic,
+        synthetic_pck_vs_topk,
+    )
+
+    size = 64  # patch16 trunk: grid 4 -> nB = 16
+    cfg = ImMatchNetConfig(
+        feature_extraction_cnn="patch16",
+        ncons_kernel_sizes=(3,), ncons_channels=(1,), nc_init="identity",
+    )
+    params = init_immatchnet(jax.random.PRNGKey(12), cfg)
+    ds = SyntheticPairDataset(
+        n=4, output_size=(size, size), seed=5, return_shift=True,
+        granularity=32,
+    )
+    batch = {
+        key: np.stack([ds[i][key] for i in range(len(ds))])
+        for key in ("source_image", "target_image", "shift")
+    }
+    sweep = synthetic_pck_vs_topk(
+        params, cfg, [batch], ks=(1, 4, 16), n_side=2, alpha=0.15
+    )
+    dense = evaluate_synthetic(params, cfg, [batch], n_side=2, alpha=0.15)
+    assert dense > 0.5  # the construction resolves shifts at all
+    assert sweep[16] == pytest.approx(dense, abs=1e-7)  # complete band
+    # partial K stays in the useful regime (at the 128px/5-5-5 proxy
+    # scale small K measures ABOVE dense — PERF.md round 8; at this tiny
+    # 4x4 grid the guarantee asserted is no-collapse)
+    assert sweep[4] >= 0.5 * dense
+    assert sweep[1] >= 0.4 * dense
+
+
+# --- config plumbing ---------------------------------------------------------
+
+
+def test_check_sparse_config_validation():
+    check_sparse_config(ImMatchNetConfig(nc_topk=0))
+    check_sparse_config(ImMatchNetConfig(nc_topk=8))
+    with pytest.raises(ValueError, match="negative"):
+        check_sparse_config(ImMatchNetConfig(nc_topk=-1))
+    with pytest.raises(ValueError, match="relocalization"):
+        check_sparse_config(
+            ImMatchNetConfig(nc_topk=8, relocalization_k_size=2)
+        )
+
+
+def test_sparse_pipeline_rejects_relocalization():
+    cfg = ImMatchNetConfig(
+        nc_topk=4, relocalization_k_size=2, **BASE
+    )
+    rng = np.random.RandomState(13)
+    fa, fb = _feats(rng, 1, 4, 4)
+    params = init_immatchnet(jax.random.PRNGKey(13), cfg)
+    with pytest.raises(ValueError, match="relocalization"):
+        match_pipeline(params["neigh_consensus"], cfg, fa, fb)
+
+
+def test_config_roundtrip_and_legacy_dicts():
+    cfg = ImMatchNetConfig(nc_topk=50, nc_topk_mutual=False)
+    again = ImMatchNetConfig.from_dict(cfg.to_dict())
+    assert again.nc_topk == 50 and again.nc_topk_mutual is False
+    # checkpoints written before the sparse path have no nc_topk keys
+    legacy = cfg.to_dict()
+    del legacy["nc_topk"], legacy["nc_topk_mutual"]
+    old = ImMatchNetConfig.from_dict(legacy)
+    assert old.nc_topk == 0 and old.nc_topk_mutual is True
