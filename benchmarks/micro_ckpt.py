@@ -1,0 +1,155 @@
+"""Microbench: legacy single-file checkpoint vs per-host sharded layout.
+
+Measures durable save and restore wall time plus on-disk bytes written by
+THIS host for the same synthetic state in both layouts:
+
+  legacy   — `save_checkpoint` / `load_checkpoint`: one msgpack blob
+             (process 0 would device_get the whole tree at pod scale)
+  sharded  — `save_checkpoint_sharded` / `load_latest_valid_sharded`:
+             one `step_<N>/` directory, one durable .npy chunk per leaf,
+             per-host manifest + atomically-renamed commit marker
+
+On one process the sharded layout writes the SAME total bytes (every
+leaf is host-local) plus manifest overhead — the win it exists for is
+per-host I/O scaling (bytes/host = state/n_hosts on a pod) and the
+removal of the process-0 device_get funnel, neither of which a
+single-host microbench can show. What it CAN show, and what this
+measures, is the price of the layout on one host: chunk-granular fsync
+and digest traffic vs one big blob.
+
+Usage:
+  python benchmarks/micro_ckpt.py [--iters 3] [--leaf-kb 256] [--out DIR]
+
+Prints one JSON line per (layout, size) with `ckpt_save_ms`,
+`ckpt_restore_ms`, `ckpt_bytes_host0`.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ncnet_tpu.models.immatchnet import ImMatchNetConfig
+from ncnet_tpu.train.checkpoint import (
+    CheckpointData,
+    load_checkpoint,
+    load_latest_valid_sharded,
+    save_checkpoint,
+    save_checkpoint_sharded,
+    sharded_dir_for,
+)
+
+CFG = ImMatchNetConfig(ncons_kernel_sizes=(3,), ncons_channels=(1,))
+
+# leaf counts roughly shaped like the repo's states: "head" is the
+# NC-head-only training state (few dozen small tensors), "trunk" adds a
+# backbone's worth of leaves
+SIZES = {"head": 32, "trunk": 320}
+
+
+def synthetic_state(n_leaves, leaf_kb, seed=0):
+    rng = np.random.RandomState(seed)
+    elems = max(1, (leaf_kb * 1024) // 4)
+    return {
+        f"layer{i:04d}": rng.randn(elems).astype(np.float32)
+        for i in range(n_leaves)
+    }
+
+
+def tree_bytes(root):
+    """Unique bytes under ``root`` — hardlinked rotation history (legacy
+    ``.step<N>`` files, sharded ``best`` pointers) counts once."""
+    seen = set()
+    total = 0
+    for dirpath, _, names in os.walk(root):
+        for n in names:
+            st = os.stat(os.path.join(dirpath, n))
+            key = (st.st_dev, st.st_ino)
+            if key in seen:
+                continue
+            seen.add(key)
+            total += st.st_size
+    return total
+
+
+def bench(fn, iters):
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best * 1e3
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--leaf-kb", type=int, default=256)
+    p.add_argument("--out", default=None,
+                   help="work dir (default: a fresh temp dir, removed)")
+    args = p.parse_args()
+
+    work = args.out or tempfile.mkdtemp(prefix="micro_ckpt_")
+    try:
+        for size_name, n_leaves in SIZES.items():
+            params = synthetic_state(n_leaves, args.leaf_kb)
+            data = CheckpointData(config=CFG, params=params, step=1)
+            state_mb = sum(v.nbytes for v in params.values()) / 1e6
+
+            for layout in ("legacy", "sharded"):
+                base = os.path.join(work, f"{layout}_{size_name}")
+                os.makedirs(base, exist_ok=True)
+                path = os.path.join(base, "ck.msgpack")
+                sdir = sharded_dir_for(path)
+
+                if layout == "legacy":
+                    save_ms = bench(
+                        lambda: save_checkpoint(path, data, keep=1),
+                        args.iters,
+                    )
+                    restore_ms = bench(lambda: load_checkpoint(path),
+                                       args.iters)
+                    nbytes = tree_bytes(base)
+                else:
+                    # keep=1 so re-saves measure a steady-state rotation,
+                    # same as the legacy branch
+                    save_ms = bench(
+                        lambda: save_checkpoint_sharded(sdir, data, keep=1),
+                        args.iters,
+                    )
+                    restore_ms = bench(
+                        lambda: load_latest_valid_sharded(sdir), args.iters
+                    )
+                    nbytes = tree_bytes(sdir)
+
+                for metric, value, unit in (
+                    ("ckpt_save_ms", round(save_ms, 2), "ms"),
+                    ("ckpt_restore_ms", round(restore_ms, 2), "ms"),
+                    ("ckpt_bytes_host0", nbytes, "bytes"),
+                ):
+                    print(
+                        json.dumps({
+                            "metric": metric,
+                            "value": value,
+                            "unit": unit,
+                            "layout": layout,
+                            "size": size_name,
+                            "state_mb": round(state_mb, 1),
+                            "n_leaves": n_leaves,
+                        }),
+                        flush=True,
+                    )
+    finally:
+        if args.out is None:
+            shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

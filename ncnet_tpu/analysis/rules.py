@@ -17,6 +17,11 @@ arXiv:2004.10566, the low-precision normalization fragility):
   unchecked-gather          ``jnp.take``/``take_along_axis``/``.at[...].get()``
                             without an explicit ``mode=`` (the silent clamp
                             default masks out-of-range index bugs)
+  process-zero-only-io      O(state) I/O (``jax.device_get`` of param/state
+                            trees, artifact writes) funneled through a
+                            ``jax.process_index() == 0`` guard — the
+                            single-host serialization bottleneck the sharded
+                            checkpoint layout exists to remove
 
 All rules are intentionally conservative (intra-module reasoning only, one
 level of name expansion): a finding should mean something; the escape hatch
@@ -24,6 +29,7 @@ for justified exceptions is the mandatory-reason inline suppression.
 """
 
 import ast
+import os
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from ncnet_tpu.analysis.engine import ModuleContext, rule
@@ -525,6 +531,142 @@ def unchecked_gather(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
                     "masking index bugs; state the intended semantics "
                     "('fill', 'clip', or 'promise_in_bounds')"
                 )
+
+
+# --- process-zero-only-io ---------------------------------------------------
+
+#: argument-name substrings that mark a device_get target as O(state) — a
+#: whole parameter/optimizer tree, not a scalar metric
+_STATE_HINTS = ("param", "opt_state", "state", "weights", "grads", "tree")
+
+
+def _is_process_zero_test(ctx: ModuleContext, test: ast.AST):
+    """Classify a guard expression: returns ``"eq"`` when it contains a
+    ``jax.process_index() == 0`` comparison (the body is process-0-only),
+    ``"ne"`` for ``jax.process_index() != 0`` (an early-exit guard: the
+    FOLLOWING statements are process-0-only), else None. The comparison is
+    found anywhere inside the test (``if flag and process_index() != 0:``
+    still gates the legacy path on process 0)."""
+    for node in ast.walk(test):
+        if not (
+            isinstance(node, ast.Compare)
+            and len(node.ops) == 1
+            and len(node.comparators) == 1
+        ):
+            continue
+        sides = (node.left, node.comparators[0])
+        has_zero = any(
+            isinstance(s, ast.Constant) and s.value == 0 for s in sides
+        )
+        has_pidx = any(
+            isinstance(s, ast.Call)
+            and ctx.canonical(s.func)
+            in ("jax.process_index", "jax.distributed.process_index")
+            for s in sides
+        )
+        if not (has_zero and has_pidx):
+            continue
+        if isinstance(node.ops[0], ast.Eq):
+            return "eq"
+        if isinstance(node.ops[0], ast.NotEq):
+            return "ne"
+    return None
+
+
+def _exits_scope(stmt: ast.AST) -> bool:
+    body = getattr(stmt, "body", None) or []
+    return any(
+        isinstance(s, (ast.Return, ast.Continue, ast.Break, ast.Raise))
+        for s in body
+    )
+
+
+@rule(
+    "process-zero-only-io",
+    "warning",
+    doc="O(state) I/O funneled through a `jax.process_index() == 0` guard "
+        "(`jax.device_get` of a param/opt_state tree, or a binary artifact "
+        "write): at pod scale one host serializes ALL state over DCN and "
+        "becomes the sole preemption window. Use the per-host sharded "
+        "layout (resilience.distributed / --distributed-checkpoints) where "
+        "every process writes only its own shards; suppress with a reason "
+        "where a legacy single-file path is kept deliberately.",
+)
+def process_zero_only_io(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    if ctx.is_test:
+        return
+    parts = os.path.normpath(ctx.path).split(os.sep)
+    if "resilience" in parts:
+        return  # the package that IMPLEMENTS the discipline is exempt
+
+    # collect every statement that executes under process-0-only control:
+    # bodies of `== 0` ifs, and the statements FOLLOWING a `!= 0` early exit
+    guarded: List[ast.stmt] = []
+    for node in ast.walk(ctx.tree):
+        body = getattr(node, "body", None)
+        if not isinstance(body, list):
+            continue
+        for i, stmt in enumerate(body):
+            if not isinstance(stmt, ast.If):
+                continue
+            kind = _is_process_zero_test(ctx, stmt.test)
+            if kind == "eq":
+                guarded.extend(stmt.body)
+            elif kind == "ne" and _exits_scope(stmt):
+                guarded.extend(body[i + 1:])
+
+    seen: Set[int] = set()
+    for region in guarded:
+        for node in ast.walk(region):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            seen.add(id(node))
+            name = ctx.canonical(node.func)
+            if name == "jax.device_get":
+                hay = []
+                for arg in node.args:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Name):
+                            hay.append(sub.id)
+                        elif isinstance(sub, ast.Attribute):
+                            hay.append(sub.attr)
+                text = " ".join(hay).lower()
+                if any(h in text for h in _STATE_HINTS):
+                    yield node, (
+                        "O(state) jax.device_get behind a process-0 guard: "
+                        "one host gathers the full tree over DCN; write "
+                        "per-host shards instead (resilience.distributed / "
+                        "--distributed-checkpoints)"
+                    )
+            elif name == "open":
+                mode = None
+                if len(node.args) >= 2 and isinstance(
+                    node.args[1], ast.Constant
+                ):
+                    mode = node.args[1].value
+                for kw in node.keywords:
+                    if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                        mode = kw.value.value
+                if mode != "wb":
+                    continue
+                hay = []
+                if node.args:
+                    for sub in ast.walk(node.args[0]):
+                        if isinstance(sub, ast.Constant) and isinstance(
+                            sub.value, str
+                        ):
+                            hay.append(sub.value)
+                        elif isinstance(sub, ast.Name):
+                            hay.append(sub.id)
+                        elif isinstance(sub, ast.Attribute):
+                            hay.append(sub.attr)
+                text = " ".join(hay).lower()
+                if any(h in text for h in _ARTIFACT_HINTS):
+                    yield node, (
+                        "binary artifact write behind a process-0 guard: "
+                        "the whole save funnels through one host; use the "
+                        "per-host sharded layout (resilience.distributed)"
+                    )
 
 
 # --- mutable-default-arg ----------------------------------------------------

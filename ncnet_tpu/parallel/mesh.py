@@ -25,6 +25,41 @@ def make_mesh(mesh_shape=None, axis_names=("data",), devices=None):
     return Mesh(dev_array, axis_names)
 
 
+def multiprocess_cpu_collectives_available():
+    """True when this jaxlib can run REAL multi-process collectives on the
+    CPU backend: it ships gloo TCP collectives AND the config flag that
+    wires them into the CPU client. Older jaxlibs lack one or both and
+    fail any cross-process CPU collective with "Multiprocess computations
+    aren't implemented on the CPU backend" — callers (tests, the CPU
+    drill harness) use this to skip rather than fail there."""
+    try:
+        # importing xla_bridge REGISTERS the flag; hasattr on jax.config
+        # stays False either way, so probe the value-holder table directly
+        from jax._src import xla_bridge  # noqa: F401
+        from jax._src.lib import xla_extension
+    except Exception:
+        return False
+    if not hasattr(xla_extension, "make_gloo_tcp_collectives"):
+        return False
+    holders = getattr(jax.config, "_value_holders", {})
+    return "jax_cpu_collectives_implementation" in holders
+
+
+def ensure_cpu_collectives():
+    """Select the gloo CPU collectives implementation when this jaxlib has
+    one. Must run BEFORE the CPU backend client is created (i.e. before
+    ``jax.devices()``/``jax.distributed.initialize``); returns whether
+    gloo was selected. Single-process runtimes are unaffected — gloo only
+    changes how cross-process collectives are transported."""
+    if not multiprocess_cpu_collectives_available():
+        return False
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        return False
+    return True
+
+
 def initialize_multihost(coordinator_address=None, num_processes=None,
                          process_id=None):
     """Join a multi-host JAX runtime (the NCCL/MPI-backend analog).
@@ -58,6 +93,15 @@ def initialize_multihost(coordinator_address=None, num_processes=None,
     else:
         require_n = 2
     explicit = coordinator_address is not None or num_processes is not None
+    # CPU-backend clusters (the test/drill harness) need gloo collectives
+    # selected BEFORE the client exists; on TPU pods the platform isn't
+    # cpu and this is a no-op
+    platforms = str(
+        getattr(jax.config, "jax_platforms", None)
+        or os.environ.get("JAX_PLATFORMS", "")
+    )
+    if "cpu" in platforms.split(","):
+        ensure_cpu_collectives()
     try:
         if explicit:
             jax.distributed.initialize(
@@ -157,6 +201,27 @@ def shard_batch(mesh, batch, axis="data"):
 
 
 def replicate(mesh, tree):
-    """Replicate a pytree (params, opt state) across the mesh."""
+    """Replicate a pytree (params, opt state) across the mesh.
+
+    Multi-process: a plain ``device_put`` of host values onto a
+    process-spanning sharding runs ``multihost_utils.assert_equal`` — a
+    per-leaf gloo/DCN broadcast of the whole tree just to re-check what is
+    deterministic by construction here (every host computes the same init
+    from the same PRNGKey / loads the same checkpoint), and one that the
+    gloo CPU transport handles unreliably when differently-sized ops
+    overlap. Build the global array from explicit per-device copies
+    instead: no collective, each host touches only its local devices.
+    """
     sharding = NamedSharding(mesh, P())
+    if jax.process_count() > 1:
+        def rep(x):
+            x = np.asarray(x)
+            locals_ = [
+                jax.device_put(x, d) for d in sharding.addressable_devices
+            ]
+            return jax.make_array_from_single_device_arrays(
+                x.shape, sharding, locals_
+            )
+
+        return jax.tree.map(rep, tree)
     return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)

@@ -1,0 +1,601 @@
+"""Per-host sharded checkpoint saves (the orbax-style directory layout).
+
+PR 2's durability (`resilience.durable`) is single-writer: the training
+loop funnels the whole replicated state through ``jax.device_get`` on
+process 0, so at pod scale one host serializes all state over DCN and
+becomes the sole preemption window. Here every process durably writes only
+its OWN addressable shards, and a save commits atomically for the whole
+pod or not at all:
+
+  <dir>/
+    step_000000012/                       one directory per save
+      arrays/
+        leaf00000.s0.npy  (+ .sha256)     one .npy per shard chunk, written
+        leaf00003.s0_64.npy (+ .sha256)   by exactly one process, durable
+      meta.msgpack        (+ .sha256)     tiny replicated metadata (proc 0)
+      manifest_proc00000.json (+ .sha256) per-host shard listing + digests
+      manifest_proc00001.json (+ .sha256)
+      MANIFEST.json       (+ .sha256)     COMMIT MARKER (proc 0, written
+    step_000000024/...                    last, atomically renamed)
+    best.json             (+ .sha256)     pointer to a committed save
+
+Two-phase commit: (1) each process writes its shard chunks and then a
+per-host manifest listing them with sha256 digests and partition specs —
+all through the `durable` temp+fsync+rename discipline; (2) process 0
+waits at a cross-process barrier until every host's manifest exists and
+verifies, checks that the union of manifests tiles every leaf exactly,
+and only then atomically publishes ``MANIFEST.json``. A save without a
+verifying commit manifest does not exist as far as recovery is concerned:
+`latest_valid_save` walks back past it (and past a committed save with a
+missing/corrupt shard) to the newest save where EVERY manifest entry
+verifies.
+
+Shard ownership: a leaf sharded across devices is written by whichever
+process holds each ``replica_id == 0`` shard (disjoint tiles, no
+duplicate bytes); a fully-replicated leaf is assigned round-robin by leaf
+index, so per-host I/O stays O(state / n_hosts) for the replicated
+data-parallel states this repo trains today.
+
+Restore re-shards: chunks carry their global offsets, so
+`SaveReader.read(i, sharding=...)` assembles exactly the slice each local
+device needs and builds the global array with
+``jax.make_array_from_single_device_arrays`` — the saving and restoring
+topologies (process count, mesh shape, chunk tiling) are independent.
+
+Fault points (`resilience.faultinject`), covering every phase of the
+two-phase commit: ``dckpt.shard_write`` (mid-write and rename-pending of
+each shard chunk), ``dckpt.manifest`` (meta + per-host manifest writes),
+``dckpt.barrier`` (entering the cross-process barrier), ``dckpt.commit``
+(verification done, the commit rename still pending).
+
+Unlike its siblings this module imports jax/numpy (it must introspect
+shardings), so `resilience/__init__` does NOT import it eagerly — the
+loader workers' import-light contract holds; import it explicitly.
+"""
+
+import hashlib
+import io
+import json
+import os
+import re
+import shutil
+import time
+
+import numpy as np
+
+import jax
+
+from ncnet_tpu.resilience import durable, faultinject
+
+STEP_DIR_RE = re.compile(r"^step_(\d{9})$")
+COMMIT_NAME = "MANIFEST.json"
+META_NAME = "meta.msgpack"
+BEST_NAME = "best.json"
+ARRAYS_SUBDIR = "arrays"
+FORMAT = "dckpt-v1"
+
+
+class ShardedSaveError(RuntimeError):
+    """A distributed save could not complete (barrier timeout, a host's
+    manifest failing verification, or incomplete leaf coverage)."""
+
+
+def step_dir_name(step):
+    return f"step_{int(step):09d}"
+
+
+def manifest_name(process_index):
+    return f"manifest_proc{int(process_index):05d}.json"
+
+
+def _proc_info(process_index, process_count):
+    if process_index is None:
+        process_index = jax.process_index()
+    if process_count is None:
+        process_count = jax.process_count()
+    return int(process_index), int(process_count)
+
+
+# --- shard planning ----------------------------------------------------------
+
+
+def _leaf_numpy(leaf):
+    """Host copy of a replicated/host leaf WITHOUT a global device_get:
+    a fully-replicated jax.Array carries the whole value in each local
+    shard, so the transfer is local-device -> host only."""
+    shards = getattr(leaf, "addressable_shards", None)
+    if shards:
+        return np.asarray(shards[0].data)
+    return np.asarray(leaf)
+
+
+def _shard_start_shape(shard, global_shape):
+    """Normalize a shard's index (tuple of slices) to (start, shape)."""
+    start, shape = [], []
+    for sl, dim in zip(shard.index, global_shape):
+        lo, hi, _ = sl.indices(dim)
+        start.append(int(lo))
+        shape.append(int(hi - lo))
+    return tuple(start), tuple(shape)
+
+
+def _spec_str(leaf):
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is None:
+        return None
+    spec = getattr(sharding, "spec", None)
+    return str(spec) if spec is not None else type(sharding).__name__
+
+
+def planned_chunks(leaf, leaf_index, process_index, process_count):
+    """The chunks of ``leaf`` THIS process must write.
+
+    Returns a list of ``(start, data)`` where ``start`` is the chunk's
+    offset in the global array and ``data`` a host numpy array. Sharded
+    leaves: the local ``replica_id == 0`` shards (disjoint tiles, each
+    written by exactly one process across the pod). Replicated / host
+    leaves: one full-array chunk owned by process ``leaf_index % n``.
+    """
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is not None and not sharding.is_fully_replicated:
+        out = []
+        for shard in leaf.addressable_shards:
+            if shard.replica_id != 0:
+                continue
+            start, _ = _shard_start_shape(shard, leaf.shape)
+            out.append((start, np.asarray(shard.data)))
+        return out
+    if leaf_index % process_count != process_index:
+        return []
+    arr = _leaf_numpy(leaf)
+    return [((0,) * arr.ndim, arr)]
+
+
+def _chunk_relpath(leaf_index, start):
+    tag = "_".join(str(s) for s in start)
+    return f"{ARRAYS_SUBDIR}/leaf{leaf_index:05d}.s{tag}.npy"
+
+
+def _npy_bytes(arr):
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+# --- save (collective) -------------------------------------------------------
+
+
+def _wait_for(predicate, timeout, poll, what):
+    deadline = time.monotonic() + timeout
+    while True:
+        if predicate():
+            return
+        if time.monotonic() >= deadline:
+            raise ShardedSaveError(
+                f"distributed checkpoint barrier timed out after {timeout}s "
+                f"waiting for {what}"
+            )
+        time.sleep(poll)
+
+
+def _verified_file(path):
+    """True iff ``path`` exists and its sidecar digest verifies (a missing
+    sidecar means the rename pair is still incomplete — not yet valid)."""
+    return os.path.exists(path) and durable.verify_digest(path) is True
+
+
+def save_sharded(
+    base_dir,
+    step,
+    leaves,
+    meta_blob,
+    keep=3,
+    is_best=False,
+    process_index=None,
+    process_count=None,
+    barrier_timeout=600.0,
+    poll_interval=0.05,
+):
+    """Collectively write one ``step_<N>/`` save; EVERY process calls this
+    with the same ``leaves`` structure (list of ``(key, value)`` in a
+    canonical order) and the same tiny ``meta_blob``.
+
+    Each process durably writes only its own chunks (see `planned_chunks`)
+    plus its per-host manifest; process 0 additionally writes the meta
+    file and — after the barrier confirms every host's manifest verifies
+    and the chunks tile every leaf — the atomically-renamed commit
+    manifest. Returns the committed step directory (all processes return
+    only after the commit marker is durably visible).
+    """
+    p, n = _proc_info(process_index, process_count)
+    step_dir = os.path.join(os.path.abspath(base_dir), step_dir_name(step))
+    os.makedirs(os.path.join(step_dir, ARRAYS_SUBDIR), exist_ok=True)
+
+    entries = []
+    for i, (key, leaf) in enumerate(leaves):
+        for start, data in planned_chunks(leaf, i, p, n):
+            rel = _chunk_relpath(i, start)
+            blob = _npy_bytes(data)
+            durable.durable_write_bytes(
+                os.path.join(step_dir, rel),
+                blob,
+                write_point="dckpt.shard_write",
+                rename_point="dckpt.shard_write",
+                bytes_point=None,
+            )
+            entries.append({
+                "leaf": i,
+                "key": str(key),
+                "file": rel,
+                "start": list(start),
+                "shape": list(data.shape),
+                "global_shape": list(getattr(leaf, "shape", data.shape)),
+                "dtype": str(data.dtype),
+                "spec": _spec_str(leaf),
+                "sha256": hashlib.sha256(blob).hexdigest(),
+            })
+
+    # each host verifies ITS OWN chunks before advertising them: a torn or
+    # bit-flipped local write is caught here, not at pod-wide commit time
+    for e in entries:
+        path = os.path.join(step_dir, e["file"])
+        if durable.verify_digest(path) is not True:
+            raise ShardedSaveError(
+                f"shard {path} failed post-write verification"
+            )
+
+    if p == 0:
+        durable.durable_write_bytes(
+            os.path.join(step_dir, META_NAME),
+            meta_blob,
+            write_point="dckpt.manifest",
+            rename_point="dckpt.manifest",
+            bytes_point=None,
+        )
+    man_blob = json.dumps(
+        {"format": FORMAT, "process": p, "process_count": n,
+         "step": int(step), "entries": entries},
+        sort_keys=True,
+    ).encode("utf-8")
+    durable.durable_write_bytes(
+        os.path.join(step_dir, manifest_name(p)),
+        man_blob,
+        write_point="dckpt.manifest",
+        rename_point="dckpt.manifest",
+        bytes_point=None,
+    )
+
+    faultinject.fire("dckpt.barrier")
+    commit_path = os.path.join(step_dir, COMMIT_NAME)
+    if p != 0:
+        # the commit marker IS the barrier release for non-zero processes
+        _wait_for(
+            lambda: _verified_file(commit_path),
+            barrier_timeout, poll_interval,
+            f"the commit manifest {commit_path}",
+        )
+        return step_dir
+
+    man_paths = [os.path.join(step_dir, manifest_name(q)) for q in range(n)]
+    _wait_for(
+        lambda: all(_verified_file(mp) for mp in man_paths),
+        barrier_timeout, poll_interval,
+        f"{n} per-host manifests in {step_dir}",
+    )
+    manifests = []
+    for mp in man_paths:
+        with open(mp, "rb") as f:
+            manifests.append(json.loads(f.read().decode("utf-8")))
+    _check_coverage(leaves, manifests, step_dir)
+
+    commit = {
+        "format": FORMAT,
+        "step": int(step),
+        "process_count": n,
+        "meta": {
+            "file": META_NAME,
+            "sha256": _sidecar_digest(os.path.join(step_dir, META_NAME)),
+        },
+        "manifests": [
+            {"file": manifest_name(q),
+             "sha256": _sidecar_digest(man_paths[q])}
+            for q in range(n)
+        ],
+        "leaves": [
+            {"leaf": i, "key": str(key),
+             "global_shape": list(getattr(leaf, "shape", ())),
+             "dtype": str(getattr(leaf, "dtype", "")),
+             "spec": _spec_str(leaf)}
+            for i, (key, leaf) in enumerate(leaves)
+        ],
+    }
+    faultinject.fire("dckpt.commit")
+    durable.durable_write_bytes(
+        commit_path,
+        json.dumps(commit, sort_keys=True).encode("utf-8"),
+        write_point="dckpt.commit",
+        rename_point="dckpt.commit",
+        bytes_point=None,
+    )
+
+    if is_best:
+        write_best_pointer(base_dir, step)
+    prune_saves(base_dir, keep=keep)
+    return step_dir
+
+
+def _sidecar_digest(path):
+    with open(durable.digest_path(path), "rb") as f:
+        return f.read().strip().decode("ascii")
+
+
+def _check_coverage(leaves, manifests, step_dir):
+    """The union of per-host manifests must tile every leaf exactly:
+    a host that silently wrote nothing (or a stale manifest from a
+    different topology) must fail the commit, not the eventual restore."""
+    written = {}
+    for man in manifests:
+        for e in man["entries"]:
+            written.setdefault(e["leaf"], 0)
+            written[e["leaf"]] += int(np.prod(e["shape"], dtype=np.int64))
+    for i, (key, leaf) in enumerate(leaves):
+        want = int(np.prod(getattr(leaf, "shape", ()), dtype=np.int64))
+        got = written.get(i, 0)
+        if got != want:
+            raise ShardedSaveError(
+                f"leaf {i} ({key}) coverage mismatch in {step_dir}: "
+                f"manifests list {got} elements, global shape needs {want}"
+            )
+
+
+# --- best pointer + retention ------------------------------------------------
+
+
+def write_best_pointer(base_dir, step):
+    """O(1) ``best`` in the sharded layout: a durable pointer naming an
+    already-committed save — no re-serialization of any state."""
+    durable.durable_write_bytes(
+        os.path.join(base_dir, BEST_NAME),
+        json.dumps(
+            {"step": int(step), "step_dir": step_dir_name(step)}
+        ).encode("utf-8"),
+        write_point="dckpt.manifest",
+        rename_point="dckpt.manifest",
+        bytes_point=None,
+    )
+
+
+def read_best_pointer(base_dir):
+    """The step directory the best pointer names, or None."""
+    path = os.path.join(base_dir, BEST_NAME)
+    if not os.path.exists(path):
+        return None
+    try:
+        blob = durable.read_verified_bytes(path)
+        return os.path.join(base_dir, json.loads(blob)["step_dir"])
+    except Exception as e:  # a torn pointer must not break loading
+        print(f"[resilience] ignoring invalid best pointer {path}: {e!r}",
+              flush=True)
+        return None
+
+
+def save_candidates(base_dir):
+    """All ``step_<N>/`` directories, newest-first (committed or not —
+    validity is the walk's job, not the listing's)."""
+    try:
+        names = os.listdir(base_dir)
+    except FileNotFoundError:
+        return []
+    steps = []
+    for name in names:
+        m = STEP_DIR_RE.match(name)
+        if m and os.path.isdir(os.path.join(base_dir, name)):
+            steps.append(int(m.group(1)))
+    return [
+        os.path.join(base_dir, step_dir_name(s))
+        for s in sorted(steps, reverse=True)
+    ]
+
+
+def is_committed(step_dir):
+    return _verified_file(os.path.join(step_dir, COMMIT_NAME))
+
+
+def prune_saves(base_dir, keep=3):
+    """Keep the newest ``keep`` committed saves (plus the best pointer's
+    target) and drop older ones AND stale uncommitted directories from
+    killed earlier saves. ``keep <= 0`` disables pruning entirely."""
+    if keep <= 0:
+        return
+    committed = [d for d in save_candidates(base_dir) if is_committed(d)]
+    if not committed:
+        return
+    protect = {os.path.abspath(committed[q]) for q in range(min(keep, len(committed)))}
+    best = read_best_pointer(base_dir)
+    if best:
+        protect.add(os.path.abspath(best))
+    newest = committed[0]
+    for d in save_candidates(base_dir):
+        if os.path.abspath(d) in protect:
+            continue
+        if not is_committed(d) and d >= newest:
+            continue  # an in-flight newer save from a concurrent writer
+        shutil.rmtree(d, ignore_errors=True)
+
+
+# --- load --------------------------------------------------------------------
+
+
+class SaveReader:
+    """One committed save, fully digest-verified at construction.
+
+    Construction raises (`durable.IntegrityError` / `FileNotFoundError` /
+    `ShardedSaveError`) unless the commit manifest verifies, every
+    per-host manifest matches its recorded digest, every listed shard
+    file's bytes match the manifest's digest, and the chunks tile every
+    leaf — the directory-save extension of "a save is valid only when
+    every manifest entry verifies".
+    """
+
+    def __init__(self, step_dir):
+        self.step_dir = os.path.abspath(step_dir)
+        commit_path = os.path.join(self.step_dir, COMMIT_NAME)
+        if durable.verify_digest(commit_path) is not True:
+            raise durable.IntegrityError(
+                f"{self.step_dir}: no verifying commit manifest "
+                "(uncommitted or torn save)"
+            )
+        with open(commit_path, "rb") as f:
+            self.commit = json.loads(f.read().decode("utf-8"))
+        self.step = int(self.commit["step"])
+        self._leaves = self.commit["leaves"]
+        self._chunks = {i: [] for i in range(len(self._leaves))}
+        for man_ref in self.commit["manifests"]:
+            mp = os.path.join(self.step_dir, man_ref["file"])
+            blob = self._read_checked(mp, man_ref["sha256"])
+            man = json.loads(blob.decode("utf-8"))
+            for e in man["entries"]:
+                self._chunks[e["leaf"]].append(e)
+        for i, info in enumerate(self._leaves):
+            want = int(np.prod(info["global_shape"], dtype=np.int64))
+            got = sum(
+                int(np.prod(e["shape"], dtype=np.int64))
+                for e in self._chunks[i]
+            )
+            if got != want:
+                raise ShardedSaveError(
+                    f"{self.step_dir}: leaf {i} ({info['key']}) chunks "
+                    f"cover {got} of {want} elements"
+                )
+            for e in self._chunks[i]:
+                path = os.path.join(self.step_dir, e["file"])
+                if not os.path.exists(path):
+                    raise FileNotFoundError(
+                        f"{self.step_dir}: committed manifest lists missing "
+                        f"shard {e['file']}"
+                    )
+        self._verify_all_chunks()
+
+    def _read_checked(self, path, want_sha):
+        with open(path, "rb") as f:
+            blob = f.read()
+        got = hashlib.sha256(blob).hexdigest()
+        if got != want_sha:
+            raise durable.IntegrityError(
+                f"{path}: bytes do not match the manifest digest"
+            )
+        return blob
+
+    def _verify_all_chunks(self):
+        for i in self._chunks:
+            for e in self._chunks[i]:
+                self._read_checked(
+                    os.path.join(self.step_dir, e["file"]), e["sha256"]
+                )
+
+    @property
+    def n_leaves(self):
+        return len(self._leaves)
+
+    def leaf_info(self, i):
+        return self._leaves[i]
+
+    def meta_bytes(self):
+        blob = durable.read_verified_bytes(
+            os.path.join(self.step_dir, META_NAME)
+        )
+        want = self.commit["meta"]["sha256"]
+        if hashlib.sha256(blob).hexdigest() != want:
+            raise durable.IntegrityError(
+                f"{self.step_dir}/{META_NAME} does not match the commit "
+                "manifest digest"
+            )
+        return blob
+
+    def _chunk_array(self, entry):
+        blob = self._read_checked(
+            os.path.join(self.step_dir, entry["file"]), entry["sha256"]
+        )
+        return np.load(io.BytesIO(blob), allow_pickle=False)
+
+    def _assemble_region(self, i, start, shape, dtype):
+        """Fill the region ``[start, start+shape)`` of leaf ``i`` from the
+        chunks overlapping it — only those files are read."""
+        out = np.empty(tuple(shape), dtype=dtype)
+        filled = 0
+        for e in self._chunks[i]:
+            c_start, c_shape = e["start"], e["shape"]
+            lo = [max(s, cs) for s, cs in zip(start, c_start)]
+            hi = [
+                min(s + d, cs + cd)
+                for s, d, cs, cd in zip(start, shape, c_start, c_shape)
+            ]
+            if any(h <= l for l, h in zip(lo, hi)):
+                continue
+            chunk = self._chunk_array(e)
+            src = tuple(
+                slice(l - cs, h - cs) for l, h, cs in zip(lo, hi, c_start)
+            )
+            dst = tuple(
+                slice(l - s, h - s) for l, h, s in zip(lo, hi, start)
+            )
+            if out.ndim == 0:  # scalar leaves: out[()] = ... deprecates
+                out[...] = chunk
+            else:
+                out[dst] = chunk[src]
+            filled += int(np.prod([h - l for l, h in zip(lo, hi)],
+                                  dtype=np.int64))
+        if filled != int(np.prod(shape, dtype=np.int64)):
+            raise ShardedSaveError(
+                f"{self.step_dir}: leaf {i} region {start}+{shape} not "
+                "fully covered by saved chunks"
+            )
+        return out
+
+    def read(self, i, sharding=None):
+        """Leaf ``i`` as host numpy (``sharding=None``) or as a global
+        ``jax.Array`` under ``sharding`` — each local device gets exactly
+        the slice it needs, assembled from whatever chunk tiling the SAVING
+        topology produced, then stitched with
+        ``jax.make_array_from_single_device_arrays`` (the re-shard path
+        for restores onto a different process count or mesh shape)."""
+        info = self._leaves[i]
+        gshape = tuple(info["global_shape"])
+        dtype = np.dtype(info["dtype"])
+        if sharding is None:
+            return self._assemble_region(i, (0,) * len(gshape), gshape, dtype)
+        singles = []
+        for dev, idx in sharding.addressable_devices_indices_map(
+            gshape
+        ).items():
+            start, shape = [], []
+            for sl, dim in zip(idx, gshape):
+                lo, hi, _ = sl.indices(dim)
+                start.append(int(lo))
+                shape.append(int(hi - lo))
+            part = self._assemble_region(i, start, shape, dtype)
+            singles.append(jax.device_put(part, dev))
+        return jax.make_array_from_single_device_arrays(
+            gshape, sharding, singles
+        )
+
+
+def latest_valid_save(base_dir, loader):
+    """Directory-save analog of `durable.latest_valid`: walk ``step_<N>/``
+    saves newest-first, returning ``(loader(reader), step_dir)`` for the
+    first whose EVERY manifest entry verifies AND that parses. Uncommitted
+    directories (a writer killed before the commit rename) are never
+    selected; a committed save with a missing or corrupt shard costs one
+    fallback, not the run."""
+    errors = []
+    for step_dir in save_candidates(base_dir):
+        try:
+            return loader(SaveReader(step_dir)), step_dir
+        except Exception as e:  # a torn/corrupt save must not end the walk
+            errors.append(f"{step_dir}: {e!r}")
+            print(
+                f"[resilience] skipping invalid save {step_dir}: {e!r}",
+                flush=True,
+            )
+    detail = "; ".join(errors) if errors else "no step_* directories exist"
+    raise FileNotFoundError(f"no valid sharded save in {base_dir} ({detail})")

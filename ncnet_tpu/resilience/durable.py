@@ -75,26 +75,56 @@ def _write_atomic(path, blob, mid_write_point=None, rename_point=None):
     os.replace(tmp, path)
 
 
-def durable_write_bytes(path, blob):
+def durable_write_bytes(
+    path,
+    blob,
+    write_point="checkpoint.write",
+    rename_point="checkpoint.rename",
+    bytes_point="checkpoint.bytes",
+):
     """Durably write ``blob`` to ``path`` with a sidecar digest.
 
     The digest is computed over the INTENDED bytes before any injected
-    corruption, so the ``checkpoint.bytes`` fault models disk damage that
-    verification must catch.
+    corruption, so the ``bytes_point`` fault models disk damage that
+    verification must catch. Callers with their own failure-drill
+    vocabulary (e.g. the sharded layout's ``dckpt.*`` points) override the
+    point names; ``None`` disables that window's hook.
     """
     path = os.path.abspath(path)
     dirname = os.path.dirname(path)
     os.makedirs(dirname, exist_ok=True)
     digest = hashlib.sha256(blob).hexdigest()
-    blob = faultinject.fire("checkpoint.bytes", blob)
+    if bytes_point:
+        blob = faultinject.fire(bytes_point, blob)
     _write_atomic(
         path, blob,
-        mid_write_point="checkpoint.write",
-        rename_point="checkpoint.rename",
+        mid_write_point=write_point,
+        rename_point=rename_point,
     )
     _write_atomic(digest_path(path), digest.encode("ascii"))
     _fsync_dir(dirname)
     return path
+
+
+def link_or_copy(src, dst):
+    """Publish ``dst`` (+ sidecar) as a hardlink to the already-durable
+    ``src`` — O(1) bytes where the filesystem supports links, falling back
+    to a copy where it does not. Used for ``best_`` pointers: the source
+    artifact is already committed, so re-serializing the payload would be
+    pure O(state) waste."""
+    for s in (src, digest_path(src)):
+        d = dst if s == src else digest_path(dst)
+        if not os.path.exists(s):
+            continue
+        try:
+            if os.path.exists(d):
+                os.remove(d)
+            os.link(s, d)
+        except OSError:
+            import shutil
+
+            shutil.copyfile(s, d)
+    _fsync_dir(os.path.dirname(os.path.abspath(dst)))
 
 
 def verify_digest(path):

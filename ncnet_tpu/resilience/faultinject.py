@@ -14,6 +14,13 @@ the failures the recovery paths claim to survive:
   ``checkpoint.bytes``          the serialized payload itself (corrupt target)
   ``step.boundary``             after each optimizer step in the training loop
   ``data.batch``                batch construction inside a loader worker
+  ``dckpt.shard_write``         sharded layout: mid-write AND rename-pending of
+                                each per-host shard chunk (two hits per chunk)
+  ``dckpt.manifest``            sharded layout: meta + per-host manifest writes
+  ``dckpt.barrier``             sharded layout: entering the cross-process
+                                commit barrier (shards + manifest on disk)
+  ``dckpt.commit``              sharded layout: pod-wide verification passed,
+                                the atomic commit-manifest rename still pending
   ============================  =================================================
 
 Actions: ``crash`` raises :class:`InjectedFault` (unwinds normally, finally

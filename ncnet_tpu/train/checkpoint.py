@@ -22,6 +22,7 @@ torn or corrupt latest file instead of crashing the resume.
 
 import dataclasses
 import os
+import re
 from typing import Any, Optional
 
 import jax
@@ -134,11 +135,12 @@ def save_checkpoint(path, data: CheckpointData, is_best=False, keep=3):
     durable.durable_write_bytes(path, blob)
     durable.retain(path, data.step, keep=keep)
     if is_best:
-        # the same durable path as the main file: the old shutil.copyfile
-        # could be observed half-written by a concurrent eval/preemption
+        # ``best_`` is a hardlinked pointer to the just-committed main file
+        # (O(1), no re-serialization of the tree); the link target was
+        # written durably above, so readers still see old-or-new, never torn
         base = os.path.basename(path)
         best = os.path.join(os.path.dirname(path), "best_" + base)
-        durable.durable_write_bytes(best, blob)
+        durable.link_or_copy(path, best)
 
 
 def load_checkpoint(path, opt_state_target=None) -> CheckpointData:
@@ -176,3 +178,167 @@ def load_latest_valid(path, opt_state_target=None):
     return durable.latest_valid(
         path, lambda p: load_checkpoint(p, opt_state_target=opt_state_target)
     )
+
+
+# --- per-host sharded layout (resilience.distributed) ------------------------
+#
+# Same CheckpointData in, same CheckpointData out, different bytes on disk:
+# params/opt_state leaves are replaced in the msgpack meta payload by
+# ``__dckpt_leaf_<i>__`` references and the tensor bytes go through
+# `distributed.save_sharded` — every process writes only its own shards,
+# nothing O(state) crosses a single host. The meta payload is otherwise
+# IDENTICAL to the legacy format, so cursor/history semantics (and their
+# bitwise-resume guarantees) carry over unchanged.
+
+SHARDED_SUFFIX = ".dckpt"
+
+_LEAF_REF_FMT = "__dckpt_leaf_{}__"
+_LEAF_REF_RE = re.compile(r"^__dckpt_leaf_(\d+)__$")
+
+
+def sharded_dir_for(path):
+    """The sharded-layout directory shadowing a legacy checkpoint path:
+    ``trained_models/ncnet_tpu.msgpack`` -> ``trained_models/ncnet_tpu.dckpt``
+    (auto-migration keeps both names stable across the format switch)."""
+    root, _ = os.path.splitext(os.path.abspath(path))
+    return root + SHARDED_SUFFIX
+
+
+def _sharded_parts(data: CheckpointData):
+    """Split a CheckpointData into ``(leaves, meta_blob)``: the canonical
+    ``(key, value)`` tensor list every process must agree on, and the tiny
+    replicated msgpack payload with leaf references in place of tensors."""
+    trees = {
+        "params": data.params,
+        "opt_state": data.opt_state if data.opt_state is not None else {},
+    }
+    flat, treedef = jax.tree_util.tree_flatten_with_path(trees)
+    leaves = [(jax.tree_util.keystr(p), v) for p, v in flat]
+    refs = jax.tree_util.tree_unflatten(
+        treedef, [_LEAF_REF_FMT.format(i) for i in range(len(flat))]
+    )
+    payload = {
+        "config": data.config.to_dict(),
+        "params": serialization.to_state_dict(refs["params"]),
+        "opt_state": serialization.to_state_dict(refs["opt_state"]),
+        "step": int(data.step),
+        "epoch": int(data.epoch),
+        "train_loss": np.asarray(
+            data.train_loss if data.train_loss is not None else []
+        ),
+        "val_loss": np.asarray(data.val_loss if data.val_loss is not None else []),
+        "best_val_loss": float(
+            data.best_val_loss if data.best_val_loss is not None else np.inf
+        ),
+        "train_fe": bool(data.train_fe),
+        "fe_finetune_blocks": int(data.fe_finetune_blocks),
+        "cursor": _cursor_payload(data.cursor),
+    }
+    return leaves, serialization.msgpack_serialize(payload)
+
+
+def save_checkpoint_sharded(
+    dir_path, data: CheckpointData, is_best=False, keep=3, **save_kwargs
+):
+    """Collectively write one sharded save under ``dir_path`` — EVERY
+    process calls this with its shard-carrying (or replicated) jax arrays
+    still on device; no ``jax.device_get`` of the full tree anywhere.
+    ``is_best`` publishes the O(1) ``best.json`` pointer (no
+    re-serialization). Returns the committed ``step_<N>/`` directory."""
+    from ncnet_tpu.resilience import distributed
+
+    leaves, meta_blob = _sharded_parts(data)
+    return distributed.save_sharded(
+        dir_path, int(data.step), leaves, meta_blob,
+        keep=keep, is_best=is_best, **save_kwargs,
+    )
+
+
+def _checkpoint_from_reader(reader, opt_state_target=None, shardings=None):
+    payload = serialization.msgpack_restore(reader.meta_bytes())
+
+    def lookup_sharding(i):
+        if shardings is None:
+            return None
+        if callable(shardings):
+            return shardings(reader.leaf_info(i)["key"], reader.leaf_info(i))
+        return shardings.get(reader.leaf_info(i)["key"])
+
+    def subst(obj):
+        if isinstance(obj, str):
+            m = _LEAF_REF_RE.match(obj)
+            if m:
+                i = int(m.group(1))
+                return reader.read(i, sharding=lookup_sharding(i))
+        if isinstance(obj, dict):
+            return {k: subst(v) for k, v in obj.items()}
+        return obj
+
+    payload["params"] = subst(payload["params"])
+    payload["opt_state"] = subst(payload["opt_state"])
+    config = ImMatchNetConfig.from_dict(payload["config"])
+    opt_state = payload.get("opt_state") or None
+    if opt_state is not None and opt_state_target is not None:
+        opt_state = serialization.from_state_dict(opt_state_target, opt_state)
+    return CheckpointData(
+        config=config,
+        params=_relistify(payload["params"]),
+        opt_state=opt_state,
+        step=int(payload.get("step", 0)),
+        epoch=int(payload.get("epoch", 0)),
+        train_loss=payload.get("train_loss"),
+        val_loss=payload.get("val_loss"),
+        best_val_loss=payload.get("best_val_loss"),
+        train_fe=bool(payload.get("train_fe", False)),
+        fe_finetune_blocks=int(payload.get("fe_finetune_blocks", 0)),
+        cursor=_cursor_from_payload(payload),
+    )
+
+
+def load_checkpoint_sharded(step_dir, opt_state_target=None, shardings=None):
+    """Load one committed ``step_<N>/`` save (every manifest entry is
+    digest-verified first). ``shardings`` — a ``{leaf_key: Sharding}`` dict
+    or a ``(key, info) -> Sharding`` callable — restores those leaves as
+    global jax.Arrays re-sharded for the CURRENT topology (each process
+    reads only the chunk regions its local devices need); leaves without a
+    sharding come back as host numpy, matching `load_checkpoint`."""
+    from ncnet_tpu.resilience import distributed
+
+    return _checkpoint_from_reader(
+        distributed.SaveReader(step_dir),
+        opt_state_target=opt_state_target,
+        shardings=shardings,
+    )
+
+
+def load_latest_valid_sharded(dir_path, opt_state_target=None, shardings=None):
+    """`load_latest_valid` over the sharded layout: newest committed
+    ``step_<N>/`` whose every manifest entry verifies; walks back past
+    uncommitted/torn directories AND committed saves with missing or
+    corrupt shards. Returns ``(CheckpointData, step_dir)``."""
+    from ncnet_tpu.resilience import distributed
+
+    return distributed.latest_valid_save(
+        dir_path,
+        lambda reader: _checkpoint_from_reader(
+            reader, opt_state_target=opt_state_target, shardings=shardings
+        ),
+    )
+
+
+def load_latest_valid_any(path, opt_state_target=None, shardings=None):
+    """Resume from whatever layout exists at ``path``: its sharded shadow
+    directory when that holds a committed save (preferring the newer
+    format), else the legacy single file — a run migrated mid-history
+    resumes from the right place either way."""
+    sharded = path if os.path.isdir(path) else sharded_dir_for(path)
+    if os.path.isdir(sharded):
+        try:
+            return load_latest_valid_sharded(
+                sharded, opt_state_target=opt_state_target,
+                shardings=shardings,
+            )
+        except FileNotFoundError:
+            if os.path.isdir(path):
+                raise  # explicitly a directory: no legacy fallback exists
+    return load_latest_valid(path, opt_state_target=opt_state_target)

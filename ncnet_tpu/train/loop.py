@@ -29,7 +29,12 @@ import jax.numpy as jnp
 from ncnet_tpu.analysis import sanitizer
 from ncnet_tpu.parallel.mesh import make_hybrid_mesh, replicate, shard_batch
 from ncnet_tpu.resilience import faultinject
-from ncnet_tpu.train.checkpoint import CheckpointData, save_checkpoint
+from ncnet_tpu.train.checkpoint import (
+    CheckpointData,
+    save_checkpoint,
+    save_checkpoint_sharded,
+    sharded_dir_for,
+)
 from ncnet_tpu.train.step import (
     create_train_state,
     make_eval_step,
@@ -157,6 +162,7 @@ def train(
     keep_checkpoints=3,
     preemption=None,
     from_features=False,
+    distributed_checkpoints=False,
 ):
     """Run the training loop; returns ``(state, history)``.
 
@@ -173,6 +179,13 @@ def train(
     triggers one final snapshot and a clean early return —
     ``history["preempted"]`` reports which way the loop ended. Loaders
     exposing ``close()`` are closed on every exit path.
+
+    ``distributed_checkpoints=True`` switches saves to the per-host
+    sharded layout (`resilience.distributed`): EVERY process participates
+    in each snapshot, writing only its own addressable shards under
+    ``<checkpoint_name stem>.dckpt/step_<N>/`` — the O(state) process-0
+    ``device_get`` funnel of the legacy path disappears. Metrics and plots
+    stay process-0-only (they are tiny and host-side either way).
     """
     try:
         return _train_impl(
@@ -182,7 +195,7 @@ def train(
             start_batch, start_epoch_losses, opt_state, initial_best_val,
             initial_train_hist, initial_val_hist, log_every, profile_dir,
             profile_steps, save_every_steps, keep_checkpoints, preemption,
-            from_features,
+            from_features, distributed_checkpoints,
         )
     finally:
         _close_quietly(train_loader, val_loader)
@@ -194,7 +207,7 @@ def _train_impl(
     data_parallel, start_epoch, start_step, start_batch, start_epoch_losses,
     opt_state, initial_best_val, initial_train_hist, initial_val_hist,
     log_every, profile_dir, profile_steps, save_every_steps,
-    keep_checkpoints, preemption, from_features,
+    keep_checkpoints, preemption, from_features, distributed_checkpoints,
 ):
     if from_features:
         from ncnet_tpu.train.step import check_from_features_frozen
@@ -247,9 +260,11 @@ def _train_impl(
 
     def snapshot(epoch, losses, is_best=False, cursor_batch=None):
         """One durable checkpoint; ``cursor_batch`` marks a mid-epoch
-        snapshot carrying the loader cursor for step-granular resume."""
-        if jax.process_index() != 0:
-            return  # multi-host: only process 0 writes checkpoints
+        snapshot carrying the loader cursor for step-granular resume.
+        Sharded mode is COLLECTIVE — every process enters and writes its
+        own shards; legacy mode stays process-0-only."""
+        if not distributed_checkpoints and jax.process_index() != 0:
+            return  # legacy multi-host: only process 0 writes checkpoints
         cursor = None
         if cursor_batch is not None:
             cursor = {
@@ -264,20 +279,39 @@ def _train_impl(
                 "epoch_losses": list(losses.host()),
             }
         os.makedirs(checkpoint_dir, exist_ok=True)
+        common = dict(
+            config=config,
+            step=int(state.step),
+            epoch=epoch if cursor_batch is not None else epoch + 1,
+            train_loss=np.asarray(train_hist),
+            val_loss=np.asarray(val_hist),
+            best_val_loss=best_val,
+            train_fe=train_fe,
+            fe_finetune_blocks=fe_finetune_blocks,
+            cursor=cursor,
+        )
+        if distributed_checkpoints:
+            # params/opt_state stay on device: each process serializes
+            # only the shard chunks it owns — nothing O(state) funnels
+            # through any single host
+            save_checkpoint_sharded(
+                sharded_dir_for(os.path.join(checkpoint_dir, checkpoint_name)),
+                CheckpointData(
+                    params=state.params, opt_state=state.opt_state, **common
+                ),
+                is_best=is_best,
+                keep=keep_checkpoints,
+            )
+            return
         save_checkpoint(
             os.path.join(checkpoint_dir, checkpoint_name),
             CheckpointData(
-                config=config,
-                params=jax.device_get(state.params),
-                opt_state=jax.device_get(state.opt_state),
-                step=int(state.step),
-                epoch=epoch if cursor_batch is not None else epoch + 1,
-                train_loss=np.asarray(train_hist),
-                val_loss=np.asarray(val_hist),
-                best_val_loss=best_val,
-                train_fe=train_fe,
-                fe_finetune_blocks=fe_finetune_blocks,
-                cursor=cursor,
+                # the O(state) process-0 gather is the legacy single-file
+                # format's defining constraint, kept deliberately for
+                # single-host runs; --distributed-checkpoints removes it
+                params=jax.device_get(state.params),  # nclint: disable=process-zero-only-io -- legacy layout needs the full tree on one host
+                opt_state=jax.device_get(state.opt_state),  # nclint: disable=process-zero-only-io -- legacy layout needs the full tree on one host
+                **common,
             ),
             is_best=is_best,
             keep=keep_checkpoints,
@@ -380,32 +414,37 @@ def _train_impl(
             + (" [best]" if is_best else ""),
             flush=True,
         )
-        if jax.process_index() != 0:
-            continue  # multi-host: only process 0 writes checkpoints
-        # Persisted observability (SURVEY §5: the reference is print-only;
-        # its loss arrays live only inside checkpoints): per-epoch metrics
-        # as JSONL plus a loss-curve figure, next to the checkpoint.
-        os.makedirs(checkpoint_dir, exist_ok=True)
-        with open(metrics_path, "a") as f:
-            f.write(json.dumps({
-                "epoch": epoch + 1,
-                "train_loss": train_loss,
-                # strict JSON: NaN (no/empty val loader) is not valid JSON
-                "val_loss": None if np.isnan(val_loss) else val_loss,
-                "epoch_seconds": round(epoch_s, 2),
-                "steps": int(state.step),
-                "best": bool(is_best),
-            }) + "\n")
-        try:
-            import matplotlib.pyplot as plt
+        # Metrics/plots stay process-0-only (tiny, host-side); the snapshot
+        # below runs on EVERY process — in sharded mode it is a collective
+        # (non-zero processes no-op out of it in the legacy layout).
+        if jax.process_index() == 0:
+            # Persisted observability (SURVEY §5: the reference is
+            # print-only; its loss arrays live only inside checkpoints):
+            # per-epoch metrics as JSONL plus a loss-curve figure, next to
+            # the checkpoint.
+            os.makedirs(checkpoint_dir, exist_ok=True)
+            with open(metrics_path, "a") as f:
+                f.write(json.dumps({
+                    "epoch": epoch + 1,
+                    "train_loss": train_loss,
+                    # strict JSON: NaN (no/empty val loader) is not valid
+                    "val_loss": None if np.isnan(val_loss) else val_loss,
+                    "epoch_seconds": round(epoch_s, 2),
+                    "steps": int(state.step),
+                    "best": bool(is_best),
+                }) + "\n")
+            try:
+                import matplotlib.pyplot as plt
 
-            from ncnet_tpu.utils.plot import plot_loss_curves, save_plot
+                from ncnet_tpu.utils.plot import plot_loss_curves, save_plot
 
-            fig = plot_loss_curves(train_hist, val_hist)
-            save_plot(os.path.join(checkpoint_dir, "loss_curve.png"), fig=fig)
-            plt.close(fig)
-        except Exception as e:  # headless plotting must never kill training
-            print(f"loss-curve plot skipped: {e}", flush=True)
+                fig = plot_loss_curves(train_hist, val_hist)
+                save_plot(
+                    os.path.join(checkpoint_dir, "loss_curve.png"), fig=fig
+                )
+                plt.close(fig)
+            except Exception as e:  # headless plotting must never kill training
+                print(f"loss-curve plot skipped: {e}", flush=True)
         snapshot(epoch, losses, is_best=is_best)
     if sanitizer.is_enabled():
         print(sanitizer.report_text(), flush=True)

@@ -20,7 +20,7 @@ from ncnet_tpu.data.loader import DataLoader
 from ncnet_tpu.data.pairs import ImagePairDataset, SyntheticPairDataset
 from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
 from ncnet_tpu.resilience.signals import PreemptionGuard
-from ncnet_tpu.train.checkpoint import load_latest_valid
+from ncnet_tpu.train.checkpoint import load_latest_valid_any, sharded_dir_for
 from ncnet_tpu.train.loop import train
 
 
@@ -143,6 +143,16 @@ def main():
     p.add_argument("--multihost", action="store_true",
                    help="join a multi-host JAX runtime (TPU pod slices: "
                         "auto-detected); shards the data loaders per host")
+    p.add_argument("--distributed-checkpoints", action="store_true",
+                   dest="distributed_checkpoints",
+                   help="per-host sharded checkpoint layout "
+                        "(resilience.distributed): every process durably "
+                        "writes only its own shards under "
+                        "<result_model_fn stem>.dckpt/step_<N>/ with a "
+                        "two-phase commit — no O(state) process-0 gather. "
+                        "Resume reads the sharded layout when present, "
+                        "else auto-migrates from the legacy single file "
+                        "on the first save")
     # 'pallas' is deliberately NOT offered: the kernel lowers only in
     # interpret mode (kernels/conv4d_pallas.py STATUS) — advertising it
     # here would crash mid-training on the target hardware.
@@ -286,10 +296,19 @@ def main():
         print(f"initialized from reference checkpoint {args.checkpoint} "
               "(weights-only: torch optimizer state is not portable)")
     elif args.checkpoint:
-        # walks back past a torn/corrupt latest file to the newest valid
-        # checkpoint (main file, then its .step<N> rotation history)
-        ck, used_path = load_latest_valid(args.checkpoint)
-        if used_path != args.checkpoint:
+        # walks back past a torn/corrupt latest save to the newest valid
+        # checkpoint — in BOTH layouts: the sharded shadow directory
+        # (committed step_<N>/ dirs, every manifest entry verified) when
+        # one exists, else the legacy file and its .step<N> history. A
+        # legacy resume with --distributed-checkpoints auto-migrates on
+        # the first save (the sharded dir shadows the legacy name).
+        ck, used_path = load_latest_valid_any(args.checkpoint)
+        # a sharded resume ALWAYS lands on a step_<N>/ dir, so only a
+        # load from outside both expected locations is a fallback (the
+        # sharded walk-back prints its own per-save skip lines)
+        if used_path != args.checkpoint and not used_path.startswith(
+            sharded_dir_for(args.checkpoint) + os.sep
+        ):
             print(f"latest checkpoint invalid; fell back to {used_path}")
         config, params = ck.config, ck.params
         if args.conv4d_impl:  # explicit flag overrides the checkpoint's
@@ -500,6 +519,7 @@ def main():
             keep_checkpoints=args.keep_checkpoints,
             preemption=guard,
             from_features=from_features,
+            distributed_checkpoints=args.distributed_checkpoints,
         )
     if history.get("preempted"):
         print("exiting after preemption checkpoint (resume with "

@@ -9,8 +9,90 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import re  # noqa: E402
+import socket  # noqa: E402
+import subprocess  # noqa: E402
+import sys  # noqa: E402
+
 import jax  # noqa: E402
 
 # The env var JAX_PLATFORMS is ignored when a TPU plugin is present in this
 # image; the config update reliably forces the CPU backend for tests.
 jax.config.update("jax_platforms", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def multiprocess_cpu_supported():
+    """Whether THIS jaxlib can run a real multi-process CPU cluster (gloo
+    collectives present and wireable). Multi-process tests skip at
+    collection time when it can't, instead of failing inside a child."""
+    from ncnet_tpu.parallel.mesh import multiprocess_cpu_collectives_available
+
+    return multiprocess_cpu_collectives_available()
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def spawn_cpu_cluster(script, n_procs=2, local_devices=2, timeout=280,
+                      extra_env=None, per_proc_env=None, args=()):
+    """Spawn ``n_procs`` child interpreters forming a 2-phase-commit-capable
+    ``jax.distributed`` CPU cluster and wait for all of them.
+
+    Each child runs ``script`` with ``JAX_PLATFORMS=cpu``,
+    ``local_devices`` virtual CPU devices, and the coordinator wiring in
+    ``_NCNET_MH_COORD`` / ``_NCNET_MH_PID`` / ``_NCNET_MH_NPROCS`` — the
+    child is expected to call `initialize_multihost` with them (which also
+    selects gloo CPU collectives). ``per_proc_env`` ({pid: {VAR: val}})
+    targets one process, e.g. an ``NCNET_FAULTS`` kill drill on a single
+    host. Returns ``[(returncode, combined_output), ...]`` in pid order; a
+    child that outlives ``timeout`` (e.g. blocked on a barrier its killed
+    peer will never reach) is killed and reports returncode None or -9.
+    """
+    port = free_port()
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        os.environ.get("XLA_FLAGS", ""),
+    ).strip()
+    procs = []
+    for pid in range(n_procs):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS=(
+                flags
+                + f" --xla_force_host_platform_device_count={local_devices}"
+            ).strip(),
+            _NCNET_MH_COORD=f"localhost:{port}",
+            _NCNET_MH_PID=str(pid),
+            _NCNET_MH_NPROCS=str(n_procs),
+        )
+        if extra_env:
+            env.update(extra_env)
+        if per_proc_env and pid in per_proc_env:
+            env.update(per_proc_env[pid])
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, script, *args],
+                cwd=REPO,
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    results = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            out = (out or "") + "\n[spawn_cpu_cluster] child timed out"
+        results.append((p.returncode, out))
+    return results

@@ -521,6 +521,99 @@ def test_cli_json_output(tmp_path, capsys):
     assert payload["findings"][0]["rule"] == "mutable-default-arg"
 
 
+# --- process-zero-only-io ---------------------------------------------------
+
+
+BAD_P0_DEVICE_GET = """
+import jax
+
+def snapshot(state, path):
+    if jax.process_index() != 0:
+        return
+    tree = jax.device_get(state.params)
+    save(path, tree)
+"""
+
+BAD_P0_EQ_BODY = """
+import jax
+
+def snapshot(state, path):
+    if jax.process_index() == 0:
+        blob = serialize(jax.device_get(state.opt_state))
+        with open(path + ".ckpt", "wb") as f:
+            f.write(blob)
+"""
+
+BAD_P0_COMPOUND_GUARD = """
+import jax
+
+def snapshot(state, path, legacy):
+    if legacy and jax.process_index() != 0:
+        return
+    tree = jax.device_get(state.params)
+"""
+
+CLEAN_P0_SCALAR = """
+import jax
+
+def log_metrics(loss, path):
+    if jax.process_index() == 0:
+        value = float(loss)
+        with open(path + ".jsonl", "a") as f:
+            f.write(str(value))
+"""
+
+CLEAN_P0_UNGUARDED = """
+import jax
+
+def snapshot_sharded(state, path):
+    # collective: every process writes its own shards, no guard
+    tree = jax.device_get(state.params)
+    save(path, tree)
+"""
+
+
+def test_process_zero_io_ne_early_exit():
+    fs = findings_for(BAD_P0_DEVICE_GET, only="process-zero-only-io")
+    assert len(fs) == 1
+    assert "device_get" in fs[0].message
+
+
+def test_process_zero_io_eq_body_and_artifact_write():
+    fs = findings_for(BAD_P0_EQ_BODY, only="process-zero-only-io")
+    assert len(fs) == 2  # the device_get AND the wb artifact write
+    assert any("device_get" in f.message for f in fs)
+    assert any("artifact write" in f.message for f in fs)
+
+
+def test_process_zero_io_compound_guard():
+    """`if legacy and process_index() != 0: return` still gates the
+    following statements on process 0 — the loop.py legacy-branch shape."""
+    fs = findings_for(BAD_P0_COMPOUND_GUARD, only="process-zero-only-io")
+    assert len(fs) == 1
+
+
+def test_process_zero_io_scalar_metrics_clean():
+    """Tiny host-side metrics I/O on process 0 is FINE — the rule targets
+    O(state) funnels, not jsonl appends."""
+    assert findings_for(CLEAN_P0_SCALAR, only="process-zero-only-io") == []
+
+
+def test_process_zero_io_unguarded_clean():
+    assert findings_for(CLEAN_P0_UNGUARDED, only="process-zero-only-io") == []
+
+
+def test_process_zero_io_exempt_paths():
+    assert findings_for(
+        BAD_P0_DEVICE_GET, path="ncnet_tpu/resilience/distributed.py",
+        only="process-zero-only-io",
+    ) == []
+    assert findings_for(
+        BAD_P0_DEVICE_GET, path="tests/test_foo.py",
+        only="process-zero-only-io",
+    ) == []
+
+
 # --- the repo-wide gate -----------------------------------------------------
 
 

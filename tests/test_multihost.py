@@ -16,12 +16,22 @@ the oracle in-process).
 
 import os
 import re
-import socket
-import subprocess
 import sys
 
 import numpy as np
 import pytest
+
+if __name__ != "__main__":  # children must not import pytest plugins
+    from conftest import multiprocess_cpu_supported, spawn_cpu_cluster
+
+    # Collection-time capability gate: a jaxlib without gloo CPU
+    # collectives CANNOT run cross-process CPU computations at all —
+    # skip (with the reason) instead of failing inside the children.
+    pytestmark = pytest.mark.skipif(
+        not multiprocess_cpu_supported(),
+        reason="this jaxlib lacks multiprocess CPU collectives "
+        "(no gloo implementation to back jax.distributed on CPU)",
+    )
 
 GRID_DEVICES = 4  # 2 processes x 2 local devices
 LOCAL_DEVICES = 2
@@ -111,40 +121,16 @@ def _child_main():
 
 
 def test_two_process_cluster_matches_single_process():
-    port = _free_port()
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    flags = re.sub(
-        r"--xla_force_host_platform_device_count=\d+",
-        "",
-        os.environ.get("XLA_FLAGS", ""),
-    ).strip()
-    procs = []
-    for pid in range(2):
-        env = dict(
-            os.environ,
-            JAX_PLATFORMS="cpu",
-            XLA_FLAGS=(
-                flags
-                + f" --xla_force_host_platform_device_count={LOCAL_DEVICES}"
-            ).strip(),
-            _NCNET_MH_COORD=f"localhost:{port}",
-            _NCNET_MH_PID=str(pid),
-        )
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, os.path.abspath(__file__)],
-                cwd=repo,
-                env=env,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
-            )
-        )
+    results = spawn_cpu_cluster(
+        os.path.abspath(__file__),
+        n_procs=2,
+        local_devices=LOCAL_DEVICES,
+        timeout=280,
+    )
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=280)
+    for code, out in results:
         outs.append(out)
-        assert p.returncode == 0, f"multihost child failed:\n{out}"
+        assert code == 0, f"multihost child failed:\n{out}"
 
     losses = []
     for out in outs:
@@ -178,12 +164,6 @@ def test_two_process_cluster_matches_single_process():
     # comparison needs an absolute floor: cross-process psum vs in-process
     # reduction order differ by O(1 ulp) = ~3e-8 here
     np.testing.assert_allclose(losses[0], float(want), rtol=1e-5, atol=1e-6)
-
-
-def _free_port():
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
 
 
 if __name__ == "__main__":
