@@ -56,7 +56,7 @@ def main():
             symmetric_batch=False,
         )
         params = init_immatchnet(jax.random.PRNGKey(0), config)
-        fn = jax.jit(make_match_fn(config))
+        fn = jax.jit(make_match_fn(config))  # nclint: disable=recompile-hazard -- one deliberate compile per conv4d impl; compile_s is part of what this benchmark measures
 
         def sync(out):
             # D2H forces execution on this platform (block_until_ready

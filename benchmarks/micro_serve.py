@@ -1,0 +1,206 @@
+"""Microbench: batched+pipelined serving vs sequential per-pair eval.
+
+The workload the ISSUE's acceptance criterion names: N image-pair
+requests over a small set of shape buckets, served two ways —
+
+  sequential — the per-pair eval shape (`eval/inloc.py` before this PR):
+               host decode+resize+normalize, then a jitted single-pair
+               match, then a synchronous D2H readout, one request at a
+               time on one thread. Host and device strictly alternate.
+  serve      — `ncnet_tpu.serve.ServeEngine`: the same requests fed from
+               --concurrency client threads; host prep workers overlap
+               the device step of the previous micro-batch, requests
+               coalesce into padded fixed-shape batches (amortizing
+               per-dispatch overhead), every (bucket, batch-size)
+               program AOT-compiled before the clock starts, results
+               read back on a dedicated thread via async D2H.
+
+Pairs are real PNG files on disk (written by this script) so the host
+stage pays real decode work, as serving would. Prints one JSON line with
+sequential_pairs_s, served_pairs_s, speedup, occupancy, and
+p50/p95/p99 latency (serving path) from `timing.percentiles` — the
+PERF.md round-10 numbers. CPU proxy discipline as PR 3/4: the overlap
+and amortization mechanics are platform-independent; absolute ms are
+not.
+
+Usage:
+  python benchmarks/micro_serve.py [--pairs 32] [--image-size 96]
+      [--concurrency 8] [--max-batch 8] [--nc-topk 0]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from timing import percentiles  # noqa: E402
+
+
+def write_pngs(root, n_images, sizes, seed=0):
+    """Synthetic PNGs across the given raw sizes; returns paths."""
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    paths = []
+    for i in range(n_images):
+        h, w = sizes[i % len(sizes)]
+        arr = rng.randint(0, 256, size=(h, w, 3), dtype=np.uint8)
+        path = os.path.join(root, f"img_{i:04d}.png")
+        Image.fromarray(arr).save(path)
+        paths.append(path)
+    return paths
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--pairs", type=int, default=48)
+    p.add_argument("--image-size", type=int, default=64,
+                   help="bucket universe max side (small: CPU proxy)")
+    p.add_argument("--raw-size", type=int, default=240,
+                   help="synthetic source PNG max side — sets the host "
+                        "decode cost per request")
+    p.add_argument("--concurrency", type=int, default=8)
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-wait-ms", type=float, default=60.0)
+    p.add_argument("--host-workers", type=int, default=2)
+    p.add_argument("--nc-topk", type=int, default=0)
+    args = p.parse_args()
+
+    import jax
+
+    from ncnet_tpu.data.images import (
+        load_image,
+        normalize_image_np,
+        resize_bilinear_np,
+    )
+    from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+    from ncnet_tpu.serve import (
+        BucketSpec,
+        ServeEngine,
+        make_serve_match_step,
+        payload_spec,
+    )
+
+    config = ImMatchNetConfig(
+        ncons_kernel_sizes=(3,),
+        ncons_channels=(1,),
+        nc_topk=args.nc_topk,
+    )
+    params = init_immatchnet(jax.random.PRNGKey(0), config)
+    apply_fn = make_serve_match_step(config)
+    spec = BucketSpec(args.image_size, 1)
+
+    def prep(pair):
+        out = []
+        for path in pair:
+            img = load_image(path)
+            h, w = spec.bucket(img.shape[0], img.shape[1])
+            out.append(
+                normalize_image_np(resize_bilinear_np(img, h, w)).astype(
+                    np.float32
+                )
+            )
+        return (out[0].shape[:2], out[1].shape[:2]), {
+            "source_image": out[0], "target_image": out[1],
+        }
+
+    with tempfile.TemporaryDirectory() as root:
+        # two raw aspect ratios -> two pair buckets in the mix
+        long = args.raw_size
+        short = (3 * args.raw_size) // 4
+        sizes = [(short, long), (long, short)]
+        images = write_pngs(root, 2 * args.pairs, sizes)
+        requests = [
+            (images[2 * i], images[2 * i + 1]) for i in range(args.pairs)
+        ]
+
+        # --- sequential per-pair baseline --------------------------------
+        jitted = jax.jit(apply_fn)
+        for pair in requests[:2]:  # compile both buckets outside the clock
+            _, payload = prep(pair)
+            jax.tree_util.tree_map(
+                np.asarray,
+                jitted(params, {k: v[None] for k, v in payload.items()}),
+            )
+        seq_lat = []
+        t0 = time.perf_counter()
+        for pair in requests:
+            t_req = time.perf_counter()
+            _, payload = prep(pair)
+            out = jitted(params, {k: v[None] for k, v in payload.items()})
+            jax.tree_util.tree_map(np.asarray, out)
+            seq_lat.append(time.perf_counter() - t_req)
+        seq_wall = time.perf_counter() - t0
+
+        # --- batched serving ---------------------------------------------
+        with ServeEngine(
+            apply_fn,
+            params,
+            max_batch=args.max_batch,
+            max_wait=args.max_wait_ms / 1e3,
+            host_workers=args.host_workers,
+            prep_fn=prep,
+        ) as engine:
+            seen = {}
+            for pair in requests:
+                key, payload = prep(pair)
+                if key not in seen:
+                    seen[key] = (key, payload_spec(payload))
+            engine.warmup(seen.values())
+
+            slots = [None] * len(requests)
+            it = iter(range(len(requests)))
+            lock = threading.Lock()
+
+            def client():
+                while True:
+                    with lock:
+                        i = next(it, None)
+                    if i is None:
+                        return
+                    slots[i] = engine.submit(requests[i])
+
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=client)
+                for _ in range(args.concurrency)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for fut in slots:
+                fut.result()
+            serve_wall = time.perf_counter() - t0
+            stats = engine.report()
+
+    pct = percentiles(stats["latencies_s"])
+    out = {
+        "pairs": args.pairs,
+        "concurrency": args.concurrency,
+        "max_batch": args.max_batch,
+        "nc_topk": args.nc_topk,
+        "sequential_pairs_s": round(args.pairs / seq_wall, 2),
+        "served_pairs_s": round(args.pairs / serve_wall, 2),
+        "speedup": round(seq_wall / serve_wall, 2),
+        "mean_occupancy": round(stats["mean_occupancy"], 3),
+        "batches": stats["batches"],
+        "recompiles_after_warmup": stats["recompiles_after_warmup"],
+        "serve_p50_ms": round(pct["p50"] * 1e3, 1),
+        "serve_p95_ms": round(pct["p95"] * 1e3, 1),
+        "serve_p99_ms": round(pct["p99"] * 1e3, 1),
+        "seq_p50_ms": round(percentiles(seq_lat)["p50"] * 1e3, 1),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

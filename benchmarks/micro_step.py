@@ -81,9 +81,8 @@ def main():
 
     # 2) one chunk's pipeline fwd (pos only), chunk samples
     c = args.loss_chunk or args.batch
-    feat = jax.jit(lambda p, x: extract_features(p, config, x))(
-        params, imgs[: 2 * c]
-    )
+    extract = jax.jit(lambda p, x: extract_features(p, config, x))
+    feat = extract(params, imgs[: 2 * c])
     fa, fb = feat[:c], feat[c : 2 * c]
 
     def mk_pipe(n):

@@ -27,6 +27,19 @@ def time_once(fn, *args):
     return time.perf_counter() - t0
 
 
+def percentiles(samples, ps=(50, 95, 99)):
+    """``{'p50': ..., 'p95': ..., 'p99': ...}`` over ``samples`` (seconds
+    or any unit — values pass through), linear interpolation. Empty input
+    gives NaNs rather than raising: a benchmark that timed nothing should
+    still emit a well-formed report."""
+    import numpy as np
+
+    if len(samples) == 0:
+        return {f"p{p}": float("nan") for p in ps}
+    arr = np.asarray(samples, dtype=np.float64)
+    return {f"p{p}": float(np.percentile(arr, p)) for p in ps}
+
+
 def time_chain(make_chain, n_lo=1, n_hi=6, iters=3):
     """Per-iteration seconds via the (n_hi - n_lo) slope.
 

@@ -22,6 +22,13 @@ arXiv:2004.10566, the low-precision normalization fragility):
                             ``jax.process_index() == 0`` guard — the
                             single-host serialization bottleneck the sharded
                             checkpoint layout exists to remove
+  recompile-hazard          ``jax.jit``/``jax.pmap`` wrappers constructed on
+                            per-call paths (inside loop bodies, or
+                            immediately invoked inside a function): each
+                            wrapper owns a FRESH compile cache, so the
+                            program retraces/recompiles every iteration —
+                            the jit-cache-churn hazard the serving engine's
+                            warm AOT executables exist to avoid
 
 All rules are intentionally conservative (intra-module reasoning only, one
 level of name expansion): a finding should mean something; the escape hatch
@@ -667,6 +674,89 @@ def process_zero_only_io(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
                         "the whole save funnels through one host; use the "
                         "per-host sharded layout (resilience.distributed)"
                     )
+
+
+# --- recompile-hazard -------------------------------------------------------
+
+#: wrapper constructors whose RESULT owns the compile cache — building one
+#: per call/iteration throws that cache away every time
+_JIT_CONSTRUCTORS = ("jax.jit", "jax.pmap")
+
+_LOOP_NODES = (ast.For, ast.AsyncFor, ast.While)
+_COMPREHENSION_NODES = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+_FUNC_BOUNDARY = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+@rule(
+    "recompile-hazard",
+    "warning",
+    doc="`jax.jit(...)`/`jax.pmap(...)` constructed on a per-call path — "
+        "inside a loop body, or immediately invoked (`jax.jit(f)(x)`) "
+        "inside a function: every jit() call returns a wrapper with its "
+        "OWN empty compile cache, so the program retraces and recompiles "
+        "on each iteration/call (jit-cache churn; the shape-driven "
+        "recompile hazard of arXiv:1810.09868, and exactly what "
+        "ncnet_tpu.serve's warm AOT executables exist to prevent). Hoist "
+        "the jit to module scope, a factory return, or a one-time "
+        "assignment; for deliberate per-shape compiles (benchmark sweeps) "
+        "suppress with a reason.",
+)
+def recompile_hazard(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    if ctx.is_test:
+        return
+    parents: Dict[ast.AST, ast.AST] = {}
+    for parent in ast.walk(ctx.tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+
+    def in_loop(node: ast.AST) -> bool:
+        """Lexically inside a loop/comprehension body WITHOUT crossing a
+        function boundary (a def nested in a loop runs on its own
+        schedule; a factory called in a loop is the caller's finding)."""
+        p = parents.get(node)
+        while p is not None:
+            if isinstance(p, _FUNC_BOUNDARY):
+                return False
+            if isinstance(p, _LOOP_NODES + _COMPREHENSION_NODES):
+                return True
+            p = parents.get(p)
+        return False
+
+    def in_function(node: ast.AST) -> bool:
+        p = parents.get(node)
+        while p is not None:
+            if isinstance(p, _FUNC_BOUNDARY):
+                return True
+            p = parents.get(p)
+        return False
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = ctx.canonical(node.func)
+        if name not in _JIT_CONSTRUCTORS:
+            continue
+        short = name.rsplit(".", 1)[-1]
+        parent = parents.get(node)
+        immediately_invoked = (
+            isinstance(parent, ast.Call) and parent.func is node
+        )
+        if in_loop(node):
+            yield node, (
+                f"jax.{short}(...) constructed inside a loop body: each "
+                "iteration builds a wrapper with a fresh compile cache and "
+                "retraces from scratch; hoist the wrapper out of the loop "
+                "(or suppress with a reason for deliberate per-shape "
+                "compile sweeps)"
+            )
+        elif immediately_invoked and in_function(node):
+            yield node, (
+                f"jax.{short}(f)(...) immediately invoked inside a "
+                "function: the wrapper (and its compile cache) is thrown "
+                "away after one call, so every call retraces and "
+                "recompiles; bind the jitted fn once (module scope, "
+                "factory, or a local reused across calls)"
+            )
 
 
 # --- mutable-default-arg ----------------------------------------------------

@@ -65,16 +65,23 @@ def collate(samples):
     return out
 
 
-def _load_sample(dataset, idx, retries, backoff):
-    """One sample with per-attempt retry + exponential backoff (transient
-    I/O: flaky NFS, racing downloads). The LAST failure propagates."""
+def retry_call(fn, retries=0, backoff=0.05):
+    """Call ``fn()`` with per-attempt retry + exponential backoff
+    (transient I/O: flaky NFS, racing downloads). The LAST failure
+    propagates. The retry primitive under `_load_sample` here and under
+    the serving engine's host prep (`ncnet_tpu.serve.engine`)."""
     for attempt in range(retries + 1):
         try:
-            return dataset[int(idx)]
+            return fn()
         except Exception:
             if attempt == retries:
                 raise
             time.sleep(backoff * (2 ** attempt))
+
+
+def _load_sample(dataset, idx, retries, backoff):
+    """One sample with per-attempt retry (see `retry_call`)."""
+    return retry_call(lambda: dataset[int(idx)], retries, backoff)
 
 
 def build_batch(dataset, indices, retries=0, backoff=0.05, skip_budget=0):

@@ -15,8 +15,9 @@ Pipeline per (query, pano) pair (eval_inloc.py:124-203):
   -> recenter normalized coords to feature-cell centers.
 
 XLA note: every distinct image shape compiles once; the k_size·stride
-quantization already buckets shapes to a small set, so the jit cache acts
-as the shape-bucketing layer.
+quantization (shared with the serving engine via
+`ncnet_tpu.serve.buckets`) already buckets shapes to a small set, so the
+jit cache acts as the shape-bucketing layer.
 """
 
 import os
@@ -36,7 +37,21 @@ from ncnet_tpu.models.feature_extraction import backbone_stride
 from ncnet_tpu.models.immatchnet import immatchnet_apply
 from ncnet_tpu.ops.matches import corr_to_matches
 
-SCALE_FACTOR = 0.0625  # 1/backbone stride (reference eval_inloc.py:77)
+# the resize-quantization rule now lives in the shared shape-bucketing
+# module (ncnet_tpu.serve.buckets) so the serving engine and this dump
+# agree on the bucket set; re-exported here for existing callers
+from ncnet_tpu.serve.buckets import SCALE_FACTOR, quantized_resize_shape
+
+__all__ = [
+    "SCALE_FACTOR",
+    "quantized_resize_shape",
+    "load_and_preprocess",
+    "make_match_fn",
+    "match_pair",
+    "dump_matches",
+    "n_match_slots",
+    "recenter",
+]
 
 
 def _to_str(x):
@@ -44,22 +59,6 @@ def _to_str(x):
     while isinstance(x, np.ndarray):
         x = x.ravel()[0]
     return str(x)
-
-
-def quantized_resize_shape(h, w, image_size, k_size, grid_multiple=None):
-    """The reference's resize rule (eval_inloc.py:84-89): max side ->
-    ``image_size``, then quantize so feature-grid dims divide by
-    ``grid_multiple`` (default: ``k_size``; the sharded path additionally
-    needs divisibility by the shard count)."""
-    m = grid_multiple if grid_multiple is not None else k_size
-    ratio = max(h, w) / image_size
-    if m <= 1:
-        return int(h / ratio), int(w / ratio)
-    s = SCALE_FACTOR
-    return (
-        int(np.floor(h / ratio * s / m) / s * m),
-        int(np.floor(w / ratio * s / m) / s * m),
-    )
 
 
 def load_and_preprocess(path, image_size, k_size, grid_multiple=None,

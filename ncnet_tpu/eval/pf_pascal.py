@@ -21,8 +21,23 @@ from ncnet_tpu.ops.matches import bilinear_point_transfer, corr_to_matches
 from ncnet_tpu.ops.metrics import pck
 
 
-def make_pck_step(config, alpha=0.1):
-    """Returns jitted ``step(params, batch) -> [b] per-pair PCK``."""
+# the batch keys the PCK step consumes (and the serving payload carries)
+PCK_BATCH_KEYS = (
+    "source_image",
+    "target_image",
+    "source_points",
+    "target_points",
+    "source_im_size",
+    "target_im_size",
+    "L_pck",
+)
+
+
+def pck_step_fn(config, alpha=0.1):
+    """Unjitted ``step(params, batch) -> [b] per-pair PCK`` — the one
+    step body shared by the jitted sequential path (`make_pck_step`) and
+    the serving path (`evaluate_serving`), so the two can only differ in
+    batching, never in math."""
 
     def step(params, batch):
         corr = immatchnet_apply(
@@ -36,7 +51,22 @@ def make_pck_step(config, alpha=0.1):
         warped = points_to_pixel_coords(warped_norm, batch["source_im_size"])
         return pck(batch["source_points"], warped, batch["L_pck"], alpha=alpha)
 
-    return jax.jit(step)
+    return step
+
+
+def make_pck_step(config, alpha=0.1):
+    """Returns jitted ``step(params, batch) -> [b] per-pair PCK``."""
+    return jax.jit(pck_step_fn(config, alpha))
+
+
+def _summarize(per_pair):
+    arr = np.asarray(per_pair)
+    valid = ~np.isnan(arr) & (arr != -1)
+    return {
+        "pck": float(arr[valid].mean()) if valid.any() else float("nan"),
+        "per_pair": per_pair,
+        "n_valid": int(valid.sum()),
+    }
 
 
 def evaluate(params, config, loader, alpha=0.1, verbose=True):
@@ -48,30 +78,71 @@ def evaluate(params, config, loader, alpha=0.1, verbose=True):
     per_pair = []
     for i, batch in enumerate(loader):
         jbatch = {
-            k: jnp.asarray(v)
-            for k, v in batch.items()
-            if k
-            in (
-                "source_image",
-                "target_image",
-                "source_points",
-                "target_points",
-                "source_im_size",
-                "target_im_size",
-                "L_pck",
-            )
+            k: jnp.asarray(v) for k, v in batch.items() if k in PCK_BATCH_KEYS
         }
         scores = np.asarray(step(params, jbatch))
         per_pair.extend(scores.tolist())
         if verbose:
             print(f"batch [{i + 1}/{len(loader)}]", flush=True)
-    arr = np.asarray(per_pair)
-    valid = ~np.isnan(arr) & (arr != -1)
-    return {
-        "pck": float(arr[valid].mean()) if valid.any() else float("nan"),
-        "per_pair": per_pair,
-        "n_valid": int(valid.sum()),
-    }
+    return _summarize(per_pair)
+
+
+def evaluate_serving(
+    params,
+    config,
+    loader,
+    alpha=0.1,
+    max_batch=8,
+    max_wait=0.002,
+    verbose=True,
+):
+    """PCK through the serving engine (`ncnet_tpu.serve`): the loader's
+    pairs are re-submitted as individual requests, dynamically coalesced
+    into padded fixed-shape micro-batches, and executed from AOT-warmed
+    programs with host/device overlap.
+
+    Per-pair scores match `evaluate` — the step body is literally the
+    same function (`pck_step_fn`) and padding is masked at readout —
+    exactly (bitwise) when the served batch size equals the loader's,
+    and to XLA batch-size-codegen ulps otherwise; so this path changes
+    throughput only (measured in benchmarks/micro_serve.py and PERF.md
+    round 10). Returns the `evaluate` schema plus a ``'serve'`` stats
+    dict (`ServeEngine.report`).
+    """
+    from ncnet_tpu.serve.engine import ServeEngine, payload_spec
+
+    step = pck_step_fn(config, alpha)
+
+    def apply(p, batch):
+        return {"pck": step(p, batch)}
+
+    futures = []
+    warmed = set()
+    with ServeEngine(
+        apply, params, max_batch=max_batch, max_wait=max_wait
+    ) as engine:
+        for i, batch in enumerate(loader):
+            arrs = {k: np.asarray(batch[k]) for k in PCK_BATCH_KEYS}
+            n = len(arrs["source_image"])
+            for j in range(n):
+                payload = {k: v[j] for k, v in arrs.items()}
+                key = (
+                    payload["source_image"].shape,
+                    payload["target_image"].shape,
+                )
+                if key not in warmed:
+                    # warm every padded batch size for a new bucket
+                    # before any of its requests dispatch: live traffic
+                    # then triggers zero compiles
+                    engine.warmup([(key, payload_spec(payload))])
+                    warmed.add(key)
+                futures.append(engine.submit(key=key, payload=payload))
+            if verbose:
+                print(f"batch [{i + 1}/{len(loader)}] submitted", flush=True)
+        per_pair = [float(np.asarray(f.result()["pck"])) for f in futures]
+        out = _summarize(per_pair)
+        out["serve"] = engine.report()
+    return out
 
 
 def pck_vs_topk(params, config, loader, ks, alpha=0.1, verbose=False):

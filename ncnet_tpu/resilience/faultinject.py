@@ -21,6 +21,10 @@ the failures the recovery paths claim to survive:
                                 commit barrier (shards + manifest on disk)
   ``dckpt.commit``              sharded layout: pod-wide verification passed,
                                 the atomic commit-manifest rename still pending
+  ``serve.request``             per-request host prep in the serving engine
+                                (`ncnet_tpu.serve`): fires on a worker thread
+                                before decode/resize, so delay/crash exercises
+                                slow or failed requests without stalling others
   ============================  =================================================
 
 Actions: ``crash`` raises :class:`InjectedFault` (unwinds normally, finally

@@ -1,0 +1,45 @@
+"""Batched, shape-bucketed correspondence serving.
+
+Turns the per-pair match pipeline into a warm, batched, overlapped
+request path:
+
+* :mod:`ncnet_tpu.serve.buckets` — the quantized-resize shape-bucketing
+  rule (shared with `eval/inloc.py`), jit-static by construction;
+* :mod:`ncnet_tpu.serve.batcher` — dynamic micro-batching: concurrent
+  requests coalesced per bucket into padded fixed-shape batches under a
+  max-wait deadline and a max-batch cap;
+* :mod:`ncnet_tpu.serve.engine` — lifecycle + pipelining: warmup
+  AOT-compiles every (bucket, batch-size) shape, then host prep workers
+  -> device dispatch -> async D2H readout run double-buffered.
+
+Padding is masked at readout, so padded rows NEVER perturb real results:
+a served batch is bitwise identical to the same compiled program on the
+same padded array, and a lone request (padded to batch 1) is bitwise the
+per-pair pipeline. Across different padded batch sizes, results agree to
+the few-ulp float associativity of XLA's batch-size-dependent codegen —
+the only permitted difference (tests/test_serve.py pins all three).
+"""
+
+from ncnet_tpu.serve.batcher import MicroBatch, MicroBatcher, default_batch_sizes
+from ncnet_tpu.serve.buckets import (
+    SCALE_FACTOR,
+    BucketSpec,
+    pair_bucket,
+    quantized_resize_shape,
+    request_buckets,
+)
+from ncnet_tpu.serve.engine import ServeEngine, make_serve_match_step, payload_spec
+
+__all__ = [
+    "SCALE_FACTOR",
+    "BucketSpec",
+    "MicroBatch",
+    "MicroBatcher",
+    "ServeEngine",
+    "default_batch_sizes",
+    "make_serve_match_step",
+    "pair_bucket",
+    "payload_spec",
+    "quantized_resize_shape",
+    "request_buckets",
+]

@@ -1,0 +1,181 @@
+"""Dynamic micro-batching: coalesce concurrent requests per shape bucket.
+
+The batcher is deliberately PASSIVE — a lock-protected data structure
+with ``add() / ready() / drain()`` — and takes an injectable clock, so
+deadline behavior is deterministically testable with a fake clock and no
+real sleeps (tests/test_serve.py). The engine's dispatcher thread drives
+it.
+
+Policy (the ISSUE's contract):
+
+* requests group by an opaque ``key`` (the `buckets.pair_bucket` of the
+  request; only same-key requests may share a compiled program);
+* a group flushes when it reaches ``max_batch`` (cap) or when its OLDEST
+  request has waited ``max_wait`` seconds (deadline) — latency is bounded
+  by max_wait even at low traffic, and a lone request never waits behind
+  a full batch;
+* each flushed group becomes a :class:`MicroBatch` padded UP to the
+  smallest allowed batch size (powers of two by default, so the warmup
+  shape set stays small). Padding replicates a real request's arrays and
+  is masked at readout by the engine (only real slots are sliced out),
+  so padding never perturbs real results (see the engine's numerical
+  contract).
+
+Backpressure is the ENGINE's job (its bounded submit queue); the batcher
+itself never blocks.
+"""
+
+import dataclasses
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+
+def default_batch_sizes(max_batch):
+    """Powers of two up to and including ``max_batch`` (plus ``max_batch``
+    itself when it is not a power of two): the allowed PADDED sizes, i.e.
+    the per-bucket shape set warmup must compile."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    sizes = []
+    b = 1
+    while b < max_batch:
+        sizes.append(b)
+        b *= 2
+    sizes.append(max_batch)
+    return tuple(sizes)
+
+
+def pad_size(n, batch_sizes):
+    """Smallest allowed batch size >= ``n``."""
+    for b in batch_sizes:
+        if b >= n:
+            return b
+    raise ValueError(
+        f"group of {n} exceeds the largest allowed batch size "
+        f"{batch_sizes[-1]} (the batcher caps groups at max_batch)"
+    )
+
+
+class Request:
+    """One queued request: a bucket key, named per-sample arrays, and the
+    future its result resolves. ``t_submit`` feeds latency accounting."""
+
+    __slots__ = ("key", "payload", "future", "t_submit")
+
+    def __init__(self, key, payload, future, t_submit):
+        self.key = key
+        self.payload = payload
+        self.future = future
+        self.t_submit = t_submit
+
+
+@dataclasses.dataclass
+class MicroBatch:
+    """A flushed group: ``len(requests)`` real samples to be stacked and
+    padded to ``pad_to`` rows (the engine replicates the last real
+    payload into the padding slots and discards them at readout)."""
+
+    key: object
+    requests: List[Request]
+    pad_to: int
+
+    @property
+    def occupancy(self):
+        """Real-sample fraction of the padded batch (1.0 = no padding)."""
+        return len(self.requests) / self.pad_to
+
+
+class MicroBatcher:
+    """Per-key request coalescing under a deadline and a cap.
+
+    Thread-safe; all methods are non-blocking. ``clock`` must be a
+    monotonic ``() -> float`` (seconds); tests pass a fake.
+    """
+
+    def __init__(
+        self,
+        max_batch: int = 8,
+        max_wait: float = 0.005,
+        batch_sizes: Optional[Sequence[int]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if max_wait < 0:
+            raise ValueError(f"max_wait must be >= 0, got {max_wait}")
+        self.max_batch = max_batch
+        self.max_wait = max_wait
+        self.batch_sizes = (
+            tuple(sorted(batch_sizes))
+            if batch_sizes is not None
+            else default_batch_sizes(max_batch)
+        )
+        if self.batch_sizes[-1] < max_batch:
+            raise ValueError(
+                f"batch_sizes {self.batch_sizes} cannot hold a full "
+                f"max_batch={max_batch} group"
+            )
+        self._clock = clock
+        self._lock = threading.Lock()
+        # key -> (oldest-add time, [Request, ...]); insertion-ordered so
+        # deadline scans see oldest groups first
+        self._groups = {}
+
+    def _make_batch(self, key, reqs):
+        return MicroBatch(key, reqs, pad_size(len(reqs), self.batch_sizes))
+
+    def add(self, request: Request) -> Optional[MicroBatch]:
+        """Queue a request; returns a full MicroBatch if this add filled
+        its group to ``max_batch``, else None."""
+        with self._lock:
+            entry = self._groups.get(request.key)
+            if entry is None:
+                self._groups[request.key] = (self._clock(), [request])
+                return None
+            entry[1].append(request)
+            if len(entry[1]) >= self.max_batch:
+                del self._groups[request.key]
+                return self._make_batch(request.key, entry[1])
+            return None
+
+    def ready(self, now: Optional[float] = None) -> List[MicroBatch]:
+        """Pop every group whose deadline has expired (oldest request
+        waited >= max_wait). Full groups never sit here — `add` returns
+        them immediately."""
+        if now is None:
+            now = self._clock()
+        out = []
+        with self._lock:
+            expired = [
+                key
+                for key, (t0, _) in self._groups.items()
+                if now - t0 >= self.max_wait
+            ]
+            for key in expired:
+                _, reqs = self._groups.pop(key)
+                out.append(self._make_batch(key, reqs))
+        return out
+
+    def drain(self) -> List[MicroBatch]:
+        """Pop everything regardless of deadline (shutdown flush)."""
+        out = []
+        with self._lock:
+            for key, (_, reqs) in self._groups.items():
+                out.append(self._make_batch(key, reqs))
+            self._groups.clear()
+        return out
+
+    def next_deadline(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds until the oldest pending group expires (<= 0: already
+        expired), or None when empty — the dispatcher's wait timeout."""
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            if not self._groups:
+                return None
+            t0 = min(t for t, _ in self._groups.values())
+        return (t0 + self.max_wait) - now
+
+    def pending(self) -> int:
+        """Number of queued (not yet flushed) requests."""
+        with self._lock:
+            return sum(len(reqs) for _, reqs in self._groups.values())

@@ -1,0 +1,82 @@
+"""Shape bucketing: the quantized-resize rule as a first-class module.
+
+Serving a jit-compiled model means every distinct input shape is a
+compiled program, so the resize policy IS the compile-cache policy.
+`eval/inloc.py` has always quantized its aspect-preserving resize so the
+feature grid divides the relocalization ``k_size`` — and leaned on the
+jit cache as an accidental shape-bucketing layer (its module docstring
+says as much). This module promotes that rule to a shared primitive:
+
+* :func:`quantized_resize_shape` — THE resize rule, moved verbatim from
+  `eval/inloc.py` (which now imports it from here: one formula, two
+  consumers, behavior pinned by a parity test in tests/test_serve.py);
+* :class:`BucketSpec` — a frozen, hashable description of the bucket
+  universe (``image_size``, ``k_size``, ``grid_multiple``) with per-image
+  and per-pair bucket keys;
+* :func:`request_buckets` — the distinct pair buckets of a request
+  sweep, i.e. exactly the shape set a serving engine must AOT-compile at
+  warmup.
+
+Buckets are EXACT resized shapes, not padded envelopes: two requests
+share a bucket iff their quantized shapes coincide, so batching pairs
+within a bucket pads only the BATCH dimension, never the spatial dims —
+which is what keeps padding from perturbing results at all (spatial
+padding would change the correlation support; batch padding is sliced
+away at readout).
+"""
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+SCALE_FACTOR = 0.0625  # 1/backbone stride (reference eval_inloc.py:77)
+
+
+def quantized_resize_shape(h, w, image_size, k_size, grid_multiple=None):
+    """The reference's resize rule (eval_inloc.py:84-89): max side ->
+    ``image_size``, then quantize so feature-grid dims divide by
+    ``grid_multiple`` (default: ``k_size``; the sharded path additionally
+    needs divisibility by the shard count)."""
+    m = grid_multiple if grid_multiple is not None else k_size
+    ratio = max(h, w) / image_size
+    if m <= 1:
+        return int(h / ratio), int(w / ratio)
+    s = SCALE_FACTOR
+    return (
+        int(np.floor(h / ratio * s / m) / s * m),
+        int(np.floor(w / ratio * s / m) / s * m),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketSpec:
+    """The bucket universe: which quantized shape each raw image maps to.
+
+    Frozen/hashable so a spec can key jit-static state. ``k_size`` <= 1
+    means no grid quantization beyond the integer resize (matching
+    `quantized_resize_shape`); ``grid_multiple`` widens the quantum for
+    the spatially-sharded pipeline.
+    """
+
+    image_size: int
+    k_size: int = 1
+    grid_multiple: Optional[int] = None
+
+    def bucket(self, h, w) -> Tuple[int, int]:
+        """Quantized (h, w) for a raw image of shape (h, w)."""
+        return quantized_resize_shape(
+            h, w, self.image_size, self.k_size, self.grid_multiple
+        )
+
+
+def pair_bucket(spec, src_hw, tgt_hw):
+    """Bucket key for one (source, target) request: a pair of quantized
+    shapes. Requests batch together iff their keys are equal."""
+    return (spec.bucket(*src_hw), spec.bucket(*tgt_hw))
+
+
+def request_buckets(spec, pair_shapes):
+    """Sorted distinct `pair_bucket` keys over ``(src_hw, tgt_hw)`` raw
+    shape pairs — the exact shape set to AOT-compile at warmup."""
+    return sorted({pair_bucket(spec, s, t) for s, t in pair_shapes})

@@ -1,0 +1,432 @@
+"""Serving engine: warm AOT-compiled shape buckets + a pipelined request path.
+
+Three stages, each on its own thread(s), bounded queues between them
+(double-buffered in the style of `train.loop._prefetch_device_batches`):
+
+1. **host prep** — ``host_workers`` threads pop raw requests from a
+   BOUNDED submit queue (backpressure: `submit` blocks or raises
+   ``queue.Full``), hit the ``serve.request`` fault point
+   (`resilience.faultinject` — tests inject slow/failed requests here
+   without stalling the pipeline), run ``prep_fn`` (decode/resize/
+   normalize, or a feature-store lookup) under the data loader's
+   per-attempt retry + exponential backoff (``prep_retries`` — the same
+   `data.loader.retry_call` the training loaders use for transient
+   I/O), and feed the micro-batcher;
+2. **device dispatch** — one thread drives `MicroBatcher` (cap +
+   deadline flushes), stacks each flushed group into a padded
+   fixed-shape batch, runs the AOT-compiled executable for
+   ``(bucket key, padded size)``, and starts the result's D2H via
+   ``copy_to_host_async`` the moment compute is dispatched;
+3. **readout** — one thread converts device results to numpy (the only
+   blocking sync), slices out the REAL rows (padding masked here: a
+   served batch is bitwise the same program on the same padded array,
+   and a lone bs-1 request is bitwise the per-pair pipeline — across
+   batch sizes XLA's codegen may differ by ulps, never by padding),
+   and resolves per-request futures. The readout queue depth of 2 means
+   the device computes batch i+1 while batch i is being read out.
+
+Compile discipline: `warmup` AOT-compiles every (bucket, batch-size)
+shape up front via ``jit(...).lower(...).compile()`` — reusing the
+persistent compilation cache when ``compile_cache_dir`` is set
+(`utils.compile_cache`) — and serving then calls the compiled
+executables directly, never the jit wrapper, so the steady state cannot
+retrace. A trace-time counter inside the wrapped apply fn counts every
+real compile (the counting-jit assertion in tests/test_serve.py), and
+any compile triggered by a LIVE request after warmup is reported as
+``recompiles_after_warmup`` (the number `scripts/serve.py` must show as
+zero).
+"""
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+import jax
+
+from ncnet_tpu.data.loader import retry_call
+from ncnet_tpu.resilience import faultinject
+from ncnet_tpu.serve.batcher import MicroBatcher, Request, default_batch_sizes
+
+_SENTINEL = object()
+
+
+def payload_spec(payload):
+    """Per-sample ``{name: (shape, dtype)}`` of a payload dict — the
+    warmup-time description of a bucket's arrays."""
+    return {
+        name: (tuple(np.shape(arr)), np.asarray(arr).dtype)
+        for name, arr in payload.items()
+    }
+
+
+def make_serve_match_step(config, softmax=True, from_features=False):
+    """The serving apply fn for the correspondence workload:
+    ``apply(params, batch) -> {'matches': [b, 5, n]}``.
+
+    Wraps `eval.inloc.make_match_fn(concat_directions=True)` — the same
+    forward the InLoc dump jits — so serving is the per-pair pipeline,
+    just batched: trunk (or, with ``from_features=True``, a feature-store
+    lookup upstream feeding ``[b, fh, fw, c]`` feature maps straight in),
+    dense or ``nc_topk`` sparse NC, both-direction `corr_to_matches`
+    fused into one output array. The direction concat stays inside the
+    compiled program; the batch axis is moved first so readout slices
+    one ``[5, n]`` block per request.
+    """
+    import jax.numpy as jnp
+
+    # lazy: eval.inloc imports serve.buckets, so a module-level import
+    # here would be a cycle through ncnet_tpu.serve.__init__
+    from ncnet_tpu.eval.inloc import make_match_fn
+
+    fn = make_match_fn(
+        config, softmax=softmax, concat_directions=True,
+        from_features=from_features,
+    )
+
+    def apply(params, batch):
+        out = fn(params, batch["source_image"], batch["target_image"])
+        return {"matches": jnp.moveaxis(out, 1, 0)}  # [5,b,n] -> [b,5,n]
+
+    return apply
+
+
+class ServeEngine:
+    """Batched, warm, overlapped serving of ``apply_fn(params, batch)``.
+
+    ``apply_fn`` takes ``(params, {name: [b, ...]})`` and returns a
+    pytree whose every leaf has the batch as axis 0 (per-request results
+    are sliced out along it). ``prep_fn(raw) -> (bucket_key, payload)``
+    runs on the host workers; without one, `submit` takes ``(key,
+    payload)`` directly (payload: ``{name: per-sample array}``). Requests
+    sharing a key are batched together, padded up to the next allowed
+    batch size by replicating the last real payload, and the padding rows
+    are discarded at readout — padding never perturbs real rows (bitwise
+    vs the same program unpadded; vs a different-batch-size program the
+    results agree to XLA codegen ulps, tests/test_serve.py).
+
+    Use as a context manager; `close` drains in-flight work, resolves
+    every accepted future, and joins all threads.
+    """
+
+    def __init__(
+        self,
+        apply_fn,
+        params,
+        *,
+        max_batch=8,
+        max_wait=0.005,
+        batch_sizes=None,
+        queue_limit=64,
+        host_workers=2,
+        prep_fn=None,
+        prep_retries=0,
+        retry_backoff=0.05,
+        readout_depth=2,
+        compile_cache_dir=None,
+    ):
+        if compile_cache_dir is not None:
+            from ncnet_tpu.utils.compile_cache import enable_compile_cache
+
+            enable_compile_cache(compile_cache_dir)
+        self._params = params
+        self._prep_fn = prep_fn
+        self._prep_retries = prep_retries
+        self._retry_backoff = retry_backoff
+        self.batch_sizes = (
+            tuple(sorted(batch_sizes))
+            if batch_sizes is not None
+            else default_batch_sizes(max_batch)
+        )
+        self._batcher = MicroBatcher(
+            max_batch=max_batch, max_wait=max_wait,
+            batch_sizes=self.batch_sizes,
+        )
+
+        # one jit wrapper per engine; its cache is NEVER hit in steady
+        # state (serving calls the AOT executables below), it exists to
+        # lower/compile and to count traces: the increment is a Python
+        # side effect that runs only when JAX actually retraces
+        self._trace_count = 0
+
+        def _counted_apply(p, batch):
+            self._trace_count += 1
+            return apply_fn(p, batch)
+
+        self._jit = jax.jit(_counted_apply)
+        self._compiled = {}  # (bucket key, padded size) -> executable
+        self._compile_lock = threading.Lock()
+        self._warm = False
+
+        self._stats_lock = threading.Lock()
+        self._stats = {
+            "submitted": 0,
+            "completed": 0,
+            "failed": 0,
+            "batches": 0,
+            "real_samples": 0,
+            "padded_samples": 0,
+            "recompiles_after_warmup": 0,
+            "latencies_s": [],
+        }
+
+        self._submit_q = queue.Queue(maxsize=queue_limit)
+        self._batch_q = queue.Queue()
+        self._readout_q = queue.Queue(maxsize=readout_depth)
+        self._closed = False
+        self._stop_dispatch = threading.Event()
+
+        self._workers = [
+            threading.Thread(
+                target=self._prep_loop, name=f"serve-prep-{i}", daemon=True
+            )
+            for i in range(host_workers)
+        ]
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="serve-dispatch", daemon=True
+        )
+        self._reader = threading.Thread(
+            target=self._readout_loop, name="serve-readout", daemon=True
+        )
+        for t in self._workers:
+            t.start()
+        self._dispatcher.start()
+        self._reader.start()
+
+    # ------------------------------------------------------------------
+    # compile management
+
+    def _specs(self, key, bs, pspec):
+        del key  # the bucket key is already encoded in the shapes
+        return {
+            name: jax.ShapeDtypeStruct((bs,) + tuple(shape), dtype)
+            for name, (shape, dtype) in pspec.items()
+        }
+
+    def _executable(self, key, bs, pspec, live):
+        ck = (key, bs)
+        exe = self._compiled.get(ck)
+        if exe is not None:
+            return exe
+        with self._compile_lock:
+            exe = self._compiled.get(ck)
+            if exe is None:
+                if live and self._warm:
+                    with self._stats_lock:
+                        self._stats["recompiles_after_warmup"] += 1
+                exe = self._jit.lower(
+                    self._params, self._specs(key, bs, pspec)
+                ).compile()
+                self._compiled[ck] = exe
+        return exe
+
+    def warmup(self, bucket_specs):
+        """AOT-compile every (bucket, batch size) pair up front.
+
+        ``bucket_specs``: iterable of ``(key, per-sample spec)`` where the
+        spec is `payload_spec`-shaped (``{name: (shape, dtype)}``). Each
+        key is compiled at EVERY allowed padded batch size, so a warmed
+        engine serves any traffic mix over those buckets with zero
+        compiles. Incremental: may be called again for newly-discovered
+        buckets; warmup compiles are never counted as recompiles. Returns
+        the number of compiled programs now cached.
+        """
+        for key, pspec in bucket_specs:
+            for bs in self.batch_sizes:
+                self._executable(key, bs, pspec, live=False)
+        self._warm = True
+        return len(self._compiled)
+
+    @property
+    def compile_count(self):
+        """Number of real traces so far (the counting-jit assertion)."""
+        return self._trace_count
+
+    # ------------------------------------------------------------------
+    # request path
+
+    def submit(self, raw=None, *, key=None, payload=None, timeout=None):
+        """Queue one request; returns a `concurrent.futures.Future`.
+
+        With a ``prep_fn``: pass ``raw`` (whatever the prep fn consumes).
+        Without one: pass ``key=``/``payload=``. The submit queue is
+        BOUNDED (``queue_limit``): when it is full, ``timeout=None``
+        blocks (natural backpressure), ``timeout=0`` raises
+        ``queue.Full`` immediately, and a positive timeout raises after
+        waiting that long.
+        """
+        if self._closed:
+            raise RuntimeError("submit on a closed ServeEngine")
+        if raw is None:
+            if key is None or payload is None:
+                raise ValueError(
+                    "submit needs either raw (with a prep_fn) or "
+                    "key= and payload="
+                )
+            raw = (key, payload)
+        fut = Future()
+        item = (raw, fut, time.monotonic())
+        if timeout == 0:
+            self._submit_q.put_nowait(item)  # queue.Full on backpressure
+        else:
+            self._submit_q.put(item, timeout=timeout)
+        with self._stats_lock:
+            self._stats["submitted"] += 1
+        return fut
+
+    def _prep_loop(self):
+        while True:
+            item = self._submit_q.get()
+            if item is _SENTINEL:
+                return
+            raw, fut, t_submit = item
+            try:
+                # the fault point fires ONCE per request (never retried:
+                # an injected crash must fail deterministically); the
+                # prep itself gets the loader's transient-I/O retry
+                faultinject.fire("serve.request")
+                key, payload = retry_call(
+                    lambda: (
+                        self._prep_fn(raw)
+                        if self._prep_fn is not None
+                        else raw
+                    ),
+                    self._prep_retries,
+                    self._retry_backoff,
+                )
+            except BaseException as exc:  # a failed request fails ALONE
+                self._fail(fut, exc)
+                continue
+            batch = self._batcher.add(Request(key, payload, fut, t_submit))
+            if batch is not None:  # the add filled a group to max_batch
+                self._batch_q.put(batch)
+
+    def _dispatch_loop(self):
+        while True:
+            stopping = self._stop_dispatch.is_set()
+            nd = self._batcher.next_deadline()
+            wait = 0.0 if stopping else min(
+                0.05, max(0.0, nd) if nd is not None else 0.05
+            )
+            try:
+                batch = self._batch_q.get(timeout=wait)
+            except queue.Empty:
+                batch = None
+            if batch is not None:
+                self._dispatch(batch)
+            for b in self._batcher.ready():
+                self._dispatch(b)
+            if stopping and batch is None and self._batch_q.empty():
+                # prep workers are already joined: nothing new can
+                # arrive, so one final drain flushes trailing partials
+                for b in self._batcher.drain():
+                    self._dispatch(b)
+                if self._batch_q.empty():
+                    return
+
+    def _dispatch(self, batch):
+        try:
+            reqs = batch.requests
+            names = sorted(reqs[0].payload)
+            stacked = {}
+            for name in names:
+                arrs = [np.asarray(r.payload[name]) for r in reqs]
+                # pad by replicating the last REAL sample: the padded
+                # rows run through the same program and are discarded at
+                # readout, so they only have to be shape/dtype-valid
+                arrs.extend([arrs[-1]] * (batch.pad_to - len(arrs)))
+                stacked[name] = np.stack(arrs)
+            exe = self._executable(
+                batch.key, batch.pad_to, payload_spec(reqs[0].payload),
+                live=True,
+            )
+            out = exe(self._params, stacked)
+            # start D2H immediately; the readout thread's np.asarray
+            # then finds the bytes already on their way
+            for leaf in jax.tree_util.tree_leaves(out):
+                leaf.copy_to_host_async()
+        except BaseException as exc:  # compile/shape/dispatch failure
+            for r in batch.requests:
+                self._fail(r.future, exc)
+            return
+        self._readout_q.put((batch, out))
+
+    def _readout_loop(self):
+        while True:
+            item = self._readout_q.get()
+            if item is _SENTINEL:
+                return
+            batch, out = item
+            try:
+                host = jax.tree_util.tree_map(np.asarray, out)
+            except BaseException as exc:
+                for r in batch.requests:
+                    self._fail(r.future, exc)
+                continue
+            now = time.monotonic()
+            n = len(batch.requests)
+            with self._stats_lock:
+                self._stats["batches"] += 1
+                self._stats["real_samples"] += n
+                self._stats["padded_samples"] += batch.pad_to
+                self._stats["completed"] += n
+                self._stats["latencies_s"].extend(
+                    now - r.t_submit for r in batch.requests
+                )
+            for i, r in enumerate(batch.requests):
+                # padding masked here: only rows [0, n) are ever read
+                r.future.set_result(
+                    jax.tree_util.tree_map(lambda a: a[i], host)
+                )
+
+    def _fail(self, fut, exc):
+        with self._stats_lock:
+            self._stats["failed"] += 1
+        fut.set_exception(exc)
+
+    # ------------------------------------------------------------------
+    # lifecycle / accounting
+
+    def report(self):
+        """Snapshot of serving stats: counts, mean batch occupancy,
+        latency percentiles, and the compile accounting."""
+        with self._stats_lock:
+            s = dict(self._stats)
+            lat = list(s.pop("latencies_s"))
+        s["mean_occupancy"] = (
+            s["real_samples"] / s["padded_samples"]
+            if s["padded_samples"]
+            else float("nan")
+        )
+        s["compiles"] = self._trace_count
+        s["compiled_programs"] = len(self._compiled)
+        for p in (50, 95, 99):
+            s[f"latency_p{p}_ms"] = (
+                float(np.percentile(lat, p)) * 1e3 if lat else float("nan")
+            )
+        s["latencies_s"] = lat
+        return s
+
+    def close(self):
+        """Drain in-flight work (every accepted future resolves), then
+        join all pipeline threads. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        for _ in self._workers:
+            self._submit_q.put(_SENTINEL)
+        for t in self._workers:
+            t.join()
+        self._stop_dispatch.set()
+        self._dispatcher.join()
+        self._readout_q.put(_SENTINEL)
+        self._reader.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False

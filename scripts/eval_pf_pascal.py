@@ -15,6 +15,14 @@ def main():
     p.add_argument("--eval_dataset_path", type=str, default="datasets/pf-pascal")
     p.add_argument("--batch_size", type=int, default=1)
     p.add_argument("--num_workers", type=int, default=4)
+    p.add_argument("--batch", type=int, default=0,
+                   help="serve the eval through the dynamic micro-batcher "
+                        "(ncnet_tpu.serve) with this max batch size: pairs "
+                        "are coalesced into padded fixed-shape batches from "
+                        "AOT-warmed programs; per-pair PCK matches the "
+                        "sequential path (padding masked at readout, "
+                        "tests/test_serve.py). 0 = sequential "
+                        "per-loader-batch eval")
     p.add_argument("--conv4d_impl", type=str, default="tlc",
                    help="conv4d lowering for the eval forward (overrides "
                         "the checkpoint's training-tuned mix, whose "
@@ -28,7 +36,7 @@ def main():
 
     from ncnet_tpu.data.loader import DataLoader
     from ncnet_tpu.data.pairs import PFPascalDataset
-    from ncnet_tpu.eval.pf_pascal import evaluate
+    from ncnet_tpu.eval.pf_pascal import evaluate, evaluate_serving
 
     if args.checkpoint.endswith((".pth.tar", ".pth")):
         from ncnet_tpu.utils.convert_torch import convert_checkpoint
@@ -50,10 +58,22 @@ def main():
         pck_procedure="scnet",
     )
     loader = DataLoader(dataset, args.batch_size, num_workers=args.num_workers)
-    stats = evaluate(params, config, loader)
+    if args.batch:
+        stats = evaluate_serving(params, config, loader, max_batch=args.batch)
+    else:
+        stats = evaluate(params, config, loader)
     print(f"Total: {len(dataset)}")
     print(f"Valid: {stats['n_valid']}")
     print(f"PCK: {stats['pck']:.2%}")
+    if args.batch:
+        s = stats["serve"]
+        print(
+            f"Serve: {s['completed']} pairs in {s['batches']} batches, "
+            f"occupancy {s['mean_occupancy']:.2f}, "
+            f"p50 {s['latency_p50_ms']:.0f} ms / "
+            f"p95 {s['latency_p95_ms']:.0f} ms, "
+            f"recompiles after warmup: {s['recompiles_after_warmup']}"
+        )
 
 
 if __name__ == "__main__":

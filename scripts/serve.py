@@ -1,0 +1,335 @@
+"""Batched correspondence serving driver (ncnet_tpu.serve).
+
+Feeds a CSV (or directory) of image-pair requests through the serving
+engine at a given concurrency and emits a JSON report: pairs/s, batch
+occupancy, p50/p95/p99 latency, and the compile accounting (recompiles
+after warmup MUST be 0 — the engine AOT-compiles every (bucket,
+batch-size) shape up front from the request sweep's shape headers).
+
+Request sources:
+  --pairs requests.csv     columns ``source_image,target_image`` (header
+                           optional); relative paths resolve against
+                           --root (default: the CSV's directory)
+  --images DIR             sorted image files paired consecutively
+                           ((f0,f1), (f2,f3), ...) — a quick smoke mode
+
+Modes:
+  default                  trunk + NC match per batch (dense, or sparse
+                           with --nc-topk)
+  --feature-store DIR      `GalleryFeatureStore` serving: each image's
+                           trunk features are looked up by path (extracted
+                           and durably cached on first visit), and the
+                           device step runs the NC match from features —
+                           the many-queries-against-shared-gallery shape
+  --sequential             per-pair baseline on the SAME workload (one
+                           jitted per-pair call, host prep inline): the
+                           denominator of the speedup PERF.md records
+
+Fault drills: the engine fires the ``serve.request`` fault point per
+request, so ``NCNET_FAULTS="serve.request=delay:0.5@3"`` (etc.) exercises
+slow/failed requests from the command line without code changes.
+
+Example:
+  python scripts/serve.py --checkpoint ck.msgpack --pairs req.csv \
+      --concurrency 8 --max-batch 8 --report serve_report.json
+"""
+
+import argparse
+import csv
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="ncnet_tpu batched serving driver")
+    p.add_argument("--checkpoint", type=str, required=True,
+                   help=".msgpack checkpoint or reference .pth.tar")
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--pairs", type=str,
+                     help="CSV of source_image,target_image requests")
+    src.add_argument("--images", type=str,
+                     help="directory; sorted files paired consecutively")
+    p.add_argument("--root", type=str, default=None,
+                   help="base dir for relative CSV paths (default: CSV dir)")
+    p.add_argument("--image-size", type=int, default=400,
+                   help="bucket universe: max image side after resize")
+    p.add_argument("--concurrency", type=int, default=8,
+                   help="client threads submitting requests")
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-wait-ms", type=float, default=5.0,
+                   help="micro-batcher deadline: max ms a request waits "
+                        "for batch-mates before a partial batch flushes")
+    p.add_argument("--queue-limit", type=int, default=64,
+                   help="bounded submit queue (backpressure)")
+    p.add_argument("--host-workers", type=int, default=2,
+                   help="host decode/resize worker threads")
+    p.add_argument("--prep-retries", type=int, default=0,
+                   help="per-request prep retries with exponential "
+                        "backoff (the data loader's transient-I/O "
+                        "retry, data.loader.retry_call)")
+    p.add_argument("--repeat", type=int, default=1,
+                   help="serve the request list this many times")
+    p.add_argument("--nc-topk", type=int, default=-1,
+                   help="override config.nc_topk (sparse NC band; -1 keeps "
+                        "the checkpoint's setting)")
+    p.add_argument("--conv4d_impl", type=str, default="tlc",
+                   help="conv4d lowering for the serving forward (empty "
+                        "keeps the checkpoint's; 'tlc' measured fastest "
+                        "forward-only, benchmarks/micro_pck.py)")
+    p.add_argument("--feature-store", type=str, default=None,
+                   help="GalleryFeatureStore dir: serve the NC match from "
+                        "path-keyed cached trunk features")
+    p.add_argument("--compile-cache", type=str, default="none",
+                   help="persistent XLA compile cache dir ('none' off)")
+    p.add_argument("--sequential", action="store_true",
+                   help="run the per-pair sequential baseline instead of "
+                        "the batched engine")
+    p.add_argument("--report", type=str, default=None,
+                   help="write the JSON report here too")
+    return p.parse_args(argv)
+
+
+def load_requests(args):
+    """[(src_path, tgt_path), ...] absolute, in request order."""
+    if args.images:
+        files = sorted(
+            os.path.join(args.images, f)
+            for f in os.listdir(args.images)
+            if f.lower().endswith(_IMAGE_EXTS)
+        )
+        if len(files) < 2:
+            raise ValueError(f"--images {args.images}: need >= 2 images")
+        pairs = [
+            (files[i], files[i + 1]) for i in range(0, len(files) - 1, 2)
+        ]
+    else:
+        root = args.root or os.path.dirname(os.path.abspath(args.pairs))
+        pairs = []
+        with open(args.pairs, newline="") as f:
+            for row in csv.reader(f):
+                if len(row) < 2:
+                    continue
+                a, b = row[0].strip(), row[1].strip()
+                if "source" in a.lower() and "target" in b.lower():
+                    continue  # header row
+                pairs.append(
+                    (os.path.join(root, a), os.path.join(root, b))
+                )
+        if not pairs:
+            raise ValueError(f"--pairs {args.pairs}: no requests parsed")
+    return pairs * args.repeat
+
+
+def image_shape(path):
+    """(h, w) from the file header only — no pixel decode."""
+    from PIL import Image
+
+    with Image.open(path) as im:
+        w, h = im.size
+    return h, w
+
+
+def main(argv=None):
+    args = parse_args(argv)
+
+    from ncnet_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache(args.compile_cache)
+
+    import numpy as np
+
+    import jax
+
+    from ncnet_tpu.data.images import (
+        load_image,
+        normalize_image_np,
+        resize_bilinear_np,
+    )
+    from ncnet_tpu.serve import (
+        BucketSpec,
+        ServeEngine,
+        make_serve_match_step,
+        pair_bucket,
+        payload_spec,
+    )
+
+    if args.checkpoint.endswith((".pth.tar", ".pth")):
+        from ncnet_tpu.utils.convert_torch import convert_checkpoint
+
+        config, params = convert_checkpoint(args.checkpoint)
+    else:
+        from ncnet_tpu.train.checkpoint import load_checkpoint
+
+        ck = load_checkpoint(args.checkpoint)
+        config, params = ck.config, ck.params
+    if args.conv4d_impl:
+        config = config.replace(conv4d_impl=args.conv4d_impl)
+    if args.nc_topk >= 0:
+        config = config.replace(nc_topk=args.nc_topk)
+
+    requests = load_requests(args)
+    spec = BucketSpec(args.image_size, max(config.relocalization_k_size, 1))
+
+    def load_resized(path):
+        img = load_image(path)
+        h, w = spec.bucket(img.shape[0], img.shape[1])
+        return normalize_image_np(resize_bilinear_np(img, h, w)).astype(
+            np.float32
+        )
+
+    store = None
+    extractor = None
+    if args.feature_store:
+        from ncnet_tpu.features import GalleryFeatureStore, trunk_digest
+        from ncnet_tpu.models.immatchnet import extract_features
+
+        store = GalleryFeatureStore.open_or_create(
+            args.feature_store,
+            trunk_digest(params["feature_extraction"], config, None),
+            config,
+        )
+        extractor = jax.jit(
+            lambda p, img: extract_features(p, config, img)
+        )
+
+        def featurize(path):
+            key = os.path.basename(path)
+            if store.has(key):
+                return np.asarray(store.get(key))[0]
+            feats = np.asarray(
+                extractor(params, load_resized(path)[None])
+            )
+            store.put(key, feats)
+            return feats[0]
+
+        def prep(pair):
+            src, tgt = (featurize(p) for p in pair)
+            return (src.shape, tgt.shape), {
+                "source_image": src, "target_image": tgt,
+            }
+    else:
+        def prep(pair):
+            src, tgt = (load_resized(p) for p in pair)
+            return (src.shape[:2], tgt.shape[:2]), {
+                "source_image": src, "target_image": tgt,
+            }
+
+    apply_fn = make_serve_match_step(
+        config, from_features=bool(args.feature_store)
+    )
+
+    report = {
+        "mode": "sequential" if args.sequential else "serve",
+        "n_requests": len(requests),
+        "concurrency": args.concurrency,
+        "max_batch": args.max_batch,
+        "max_wait_ms": args.max_wait_ms,
+        "nc_topk": int(config.nc_topk),
+        "feature_store": bool(args.feature_store),
+    }
+
+    if args.sequential:
+        # the per-pair baseline: one jitted wrapper (per-shape cache),
+        # host prep inline on this thread, synchronous readout
+        jitted = jax.jit(apply_fn)
+        latencies = []
+        t0 = time.perf_counter()
+        for pair in requests:
+            t_req = time.perf_counter()
+            _, payload = prep(pair)
+            out = jitted(
+                params, {k: v[None] for k, v in payload.items()}
+            )
+            jax.tree_util.tree_map(np.asarray, out)
+            latencies.append(time.perf_counter() - t_req)
+        wall = time.perf_counter() - t0
+        report.update(
+            wall_s=wall,
+            pairs_per_s=len(requests) / wall,
+            latency_p50_ms=float(np.percentile(latencies, 50)) * 1e3,
+            latency_p95_ms=float(np.percentile(latencies, 95)) * 1e3,
+            latency_p99_ms=float(np.percentile(latencies, 99)) * 1e3,
+        )
+    else:
+        with ServeEngine(
+            apply_fn,
+            params,
+            max_batch=args.max_batch,
+            max_wait=args.max_wait_ms / 1e3,
+            queue_limit=args.queue_limit,
+            host_workers=args.host_workers,
+            prep_fn=prep,
+            prep_retries=args.prep_retries,
+        ) as engine:
+            # warmup: one prep per distinct bucket discovers the payload
+            # spec (for images this only needs the file header; the
+            # feature path additionally primes the store), then every
+            # (bucket, batch-size) program AOT-compiles before the clock
+            seen = {}
+            for pair in requests:
+                key = pair_bucket(
+                    spec, image_shape(pair[0]), image_shape(pair[1])
+                )
+                if key not in seen:
+                    real_key, payload = prep(pair)
+                    seen[key] = (real_key, payload_spec(payload))
+            n_programs = engine.warmup(seen.values())
+            report["buckets"] = len(seen)
+            report["compiled_programs"] = n_programs
+
+            futures = []
+            fut_lock = threading.Lock()
+            idx = iter(range(len(requests)))
+            slots = [None] * len(requests)
+
+            def client():
+                while True:
+                    with fut_lock:
+                        i = next(idx, None)
+                    if i is None:
+                        return
+                    fut = engine.submit(requests[i])
+                    slots[i] = fut
+
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=client)
+                for _ in range(args.concurrency)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            failed = 0
+            for fut in slots:
+                try:
+                    fut.result()
+                except Exception:
+                    failed += 1
+            wall = time.perf_counter() - t0
+            stats = engine.report()
+        stats.pop("latencies_s")
+        report.update(stats)
+        report.update(
+            wall_s=wall,
+            pairs_per_s=(len(requests) - failed) / wall,
+            failed=failed,
+        )
+
+    text = json.dumps(report, indent=2, sort_keys=True)
+    print(text)
+    if args.report:
+        with open(args.report, "w") as f:
+            f.write(text + "\n")
+    return report
+
+
+if __name__ == "__main__":
+    main()

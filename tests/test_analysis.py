@@ -446,6 +446,112 @@ def test_mutable_default_clean():
     assert findings_for(CLEAN_DEFAULT, only="mutable-default-arg") == []
 
 
+# --- recompile-hazard -------------------------------------------------------
+
+
+BAD_JIT_IN_LOOP = """
+import jax
+
+def sweep(params, batches):
+    outs = []
+    for batch in batches:
+        step = jax.jit(lambda p, b: p + b)
+        outs.append(step(params, batch))
+    return outs
+"""
+
+BAD_JIT_IMMEDIATE = """
+import jax
+
+def extract(params, img, config):
+    return jax.jit(lambda p, x: p + x)(params, img)
+"""
+
+BAD_PMAP_IN_WHILE = """
+import jax
+
+def drain(params, queue):
+    while queue:
+        f = jax.pmap(lambda p: p * 2)
+        f(params)
+"""
+
+BAD_JIT_IN_COMPREHENSION = """
+import jax
+
+def build(fns):
+    return [jax.jit(f) for f in fns]
+"""
+
+CLEAN_JIT = """
+import jax
+from functools import partial
+
+step = jax.jit(lambda p, b: p + b)  # module scope: one cache forever
+
+def make_step(config):
+    return jax.jit(partial(apply, config))  # factory return
+
+def evaluate(params, batches):
+    local = jax.jit(lambda p, b: p + b)  # bound once, reused in the loop
+    return [local(params, b) for b in batches]
+
+class Engine:
+    def __init__(self, apply):
+        self._jit = jax.jit(apply)  # one wrapper per engine instance
+
+def nested_def_in_loop(fns):
+    for f in fns:
+        def runner(p):  # the def is in the loop; the jit call is not
+            g = jax.jit(f)
+            return g(p)
+        yield runner
+"""
+
+
+def test_recompile_hazard_jit_in_loop():
+    fs = findings_for(BAD_JIT_IN_LOOP, only="recompile-hazard")
+    assert len(fs) == 1
+    assert fs[0].line == 7
+    assert "loop" in fs[0].message
+
+
+def test_recompile_hazard_immediate_invoke():
+    fs = findings_for(BAD_JIT_IMMEDIATE, only="recompile-hazard")
+    assert len(fs) == 1
+    assert "immediately invoked" in fs[0].message
+
+
+def test_recompile_hazard_pmap_in_while():
+    fs = findings_for(BAD_PMAP_IN_WHILE, only="recompile-hazard")
+    assert len(fs) == 1
+    assert "pmap" in fs[0].message
+
+
+def test_recompile_hazard_comprehension():
+    fs = findings_for(BAD_JIT_IN_COMPREHENSION, only="recompile-hazard")
+    assert len(fs) == 1
+
+
+def test_recompile_hazard_clean_forms():
+    assert findings_for(CLEAN_JIT, only="recompile-hazard") == []
+
+
+def test_recompile_hazard_respects_import_alias():
+    src = BAD_JIT_IN_LOOP.replace("import jax", "from jax import jit").replace(
+        "jax.jit", "jit"
+    )
+    assert len(findings_for(src, only="recompile-hazard")) == 1
+
+
+def test_recompile_hazard_exempts_tests():
+    assert (
+        findings_for(BAD_JIT_IN_LOOP, path="tests/test_x.py",
+                     only="recompile-hazard")
+        == []
+    )
+
+
 # --- suppressions -----------------------------------------------------------
 
 

@@ -1,0 +1,505 @@
+"""ncnet_tpu.serve: bucket parity with eval/inloc, micro-batcher policy
+(deterministic via an injected fake clock), engine compile discipline
+(zero recompiles after warmup, counted at trace time), the padded-batch
+numerical contract (padding bitwise-masked; lone requests bitwise the
+per-pair pipeline; cross-batch-size agreement to XLA codegen ulps) for
+dense AND sparse NC, backpressure, fault-isolated requests, and the
+serving PF-Pascal eval."""
+
+import json
+import os
+import queue
+import subprocess
+import sys
+import time
+from concurrent.futures import Future
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+from ncnet_tpu.resilience import faultinject
+from ncnet_tpu.resilience.faultinject import InjectedFault
+from ncnet_tpu.serve import (
+    SCALE_FACTOR,
+    BucketSpec,
+    MicroBatcher,
+    ServeEngine,
+    default_batch_sizes,
+    make_serve_match_step,
+    pair_bucket,
+    payload_spec,
+    quantized_resize_shape,
+    request_buckets,
+)
+from ncnet_tpu.serve.batcher import Request, pad_size
+
+REPO = Path(__file__).resolve().parent.parent
+
+TINY = ImMatchNetConfig(ncons_kernel_sizes=(3,), ncons_channels=(1,))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.clear()
+    yield
+    faultinject.clear()
+
+
+# ----------------------------------------------------------------------
+# buckets: one resize rule, shared with eval/inloc
+
+
+def test_inloc_resize_rule_is_serve_buckets():
+    """inloc must consume THE shared rule, not a drifted copy."""
+    from ncnet_tpu.eval import inloc
+
+    assert inloc.quantized_resize_shape is quantized_resize_shape
+    assert inloc.SCALE_FACTOR == SCALE_FACTOR
+
+
+def test_bucket_spec_matches_rule_and_quantizes():
+    spec = BucketSpec(3200, 2)
+    for h, w in [(1600, 1200), (1201, 1600), (999, 1333), (3200, 2400)]:
+        assert spec.bucket(h, w) == quantized_resize_shape(h, w, 3200, 2)
+        bh, bw = spec.bucket(h, w)
+        # feature grid (stride 16) divides k_size=2
+        assert bh % 32 == 0 and bw % 32 == 0
+    # k_size <= 1: plain aspect-preserving integer resize
+    assert BucketSpec(3200, 1).bucket(1600, 1200) == (3200, 2400)
+
+
+def test_request_buckets_distinct_sorted():
+    spec = BucketSpec(64, 1)
+    pairs = [
+        ((480, 640), (640, 480)),
+        ((481, 641), (640, 480)),  # same bucket after quantization
+        ((640, 480), (480, 640)),  # reversed directions: distinct key
+    ]
+    keys = request_buckets(spec, pairs)
+    assert len(keys) == 2
+    assert keys == sorted(keys)
+    assert pair_bucket(spec, (480, 640), (640, 480)) in keys
+    assert pair_bucket(spec, (481, 641), (640, 480)) in keys  # same key
+    assert pair_bucket(spec, (640, 480), (480, 640)) in keys
+
+
+# ----------------------------------------------------------------------
+# batcher: policy under a fake clock (no sleeps)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _req(key, i=0):
+    return Request(key, {"x": np.full((2,), i, np.float32)}, Future(), 0.0)
+
+
+def test_default_batch_sizes():
+    assert default_batch_sizes(1) == (1,)
+    assert default_batch_sizes(8) == (1, 2, 4, 8)
+    assert default_batch_sizes(6) == (1, 2, 4, 6)
+    with pytest.raises(ValueError):
+        default_batch_sizes(0)
+
+
+def test_pad_size():
+    assert pad_size(3, (1, 2, 4, 8)) == 4
+    assert pad_size(8, (1, 2, 4, 8)) == 8
+    with pytest.raises(ValueError):
+        pad_size(9, (1, 2, 4, 8))
+
+
+def test_batcher_cap_flush():
+    clk = FakeClock()
+    mb = MicroBatcher(max_batch=4, max_wait=10.0, clock=clk)
+    assert all(mb.add(_req("A", i)) is None for i in range(3))
+    batch = mb.add(_req("A", 3))
+    assert batch is not None
+    assert batch.key == "A"
+    assert len(batch.requests) == 4 and batch.pad_to == 4
+    assert batch.occupancy == 1.0
+    assert mb.pending() == 0
+
+
+def test_batcher_keys_do_not_mix():
+    clk = FakeClock()
+    mb = MicroBatcher(max_batch=2, max_wait=10.0, clock=clk)
+    assert mb.add(_req("A")) is None
+    assert mb.add(_req("B")) is None
+    batch = mb.add(_req("A"))  # fills A only
+    assert batch.key == "A" and len(batch.requests) == 2
+    assert mb.pending() == 1  # B still waiting
+
+
+def test_batcher_deadline_flush_and_padding():
+    clk = FakeClock()
+    mb = MicroBatcher(max_batch=8, max_wait=0.1, clock=clk)
+    for i in range(3):
+        mb.add(_req("A", i))
+    assert mb.ready(now=0.05) == []
+    assert mb.next_deadline(now=0.05) == pytest.approx(0.05)
+    clk.t = 0.1
+    (batch,) = mb.ready()
+    assert len(batch.requests) == 3 and batch.pad_to == 4  # padded up
+    assert batch.occupancy == 0.75
+    assert mb.next_deadline() is None and mb.pending() == 0
+
+
+def test_batcher_drain():
+    clk = FakeClock()
+    mb = MicroBatcher(max_batch=8, max_wait=10.0, clock=clk)
+    mb.add(_req("A"))
+    mb.add(_req("B"))
+    batches = mb.drain()
+    assert {b.key for b in batches} == {"A", "B"}
+    assert mb.pending() == 0 and mb.drain() == []
+
+
+# ----------------------------------------------------------------------
+# engine mechanics on a trivial apply fn (fast: no model)
+
+
+def _toy_engine(**kw):
+    params = {"w": jnp.asarray(3.0, jnp.float32)}
+
+    def apply(p, batch):
+        return {"y": batch["x"] * p["w"]}
+
+    return ServeEngine(apply, params, **kw)
+
+
+def _toy_payload(n, fill):
+    return {"x": np.full((n,), fill, np.float32)}
+
+
+def test_engine_zero_recompiles_after_warmup():
+    """Warmup compiles every (bucket, padded size); mixed live traffic —
+    full batches, deadline partials, a second bucket — must then trigger
+    ZERO traces (the counting-jit assertion) and report it."""
+    with _toy_engine(max_batch=4, max_wait=0.01) as eng:
+        eng.warmup(
+            [
+                ("A", payload_spec(_toy_payload(4, 0.0))),
+                ("B", payload_spec(_toy_payload(6, 0.0))),
+            ]
+        )
+        warm_traces = eng.compile_count
+        assert warm_traces == 2 * len(default_batch_sizes(4))  # 2 keys x (1,2,4)
+
+        futs = [
+            eng.submit(key="A", payload=_toy_payload(4, float(i)))
+            for i in range(7)  # one full batch of 4 + a deadline partial of 3
+        ]
+        futs.append(eng.submit(key="B", payload=_toy_payload(6, 9.0)))
+        for i, f in enumerate(futs[:7]):
+            np.testing.assert_array_equal(
+                f.result(timeout=30)["y"], np.full((4,), 3.0 * i, np.float32)
+            )
+        np.testing.assert_array_equal(
+            futs[7].result(timeout=30)["y"], np.full((6,), 27.0, np.float32)
+        )
+        stats = eng.report()
+    assert eng.compile_count == warm_traces  # nothing retraced
+    assert stats["recompiles_after_warmup"] == 0
+    assert stats["completed"] == 8 and stats["failed"] == 0
+    assert stats["real_samples"] == 8
+    # 7 A-requests flush as 4 + 3-padded-to-4; the lone B pads to 1
+    assert stats["padded_samples"] >= stats["real_samples"]
+    assert 0.0 < stats["mean_occupancy"] <= 1.0
+
+
+def test_engine_counts_unwarmed_bucket_as_recompile():
+    with _toy_engine(max_batch=2, max_wait=0.005) as eng:
+        eng.warmup([("A", payload_spec(_toy_payload(4, 0.0)))])
+        fut = eng.submit(key="B", payload=_toy_payload(5, 1.0))  # never warmed
+        np.testing.assert_array_equal(
+            fut.result(timeout=30)["y"], np.full((5,), 3.0, np.float32)
+        )
+        stats = eng.report()
+    assert stats["recompiles_after_warmup"] == 1
+
+
+def test_engine_backpressure_queue_full():
+    """The bounded submit queue rejects (timeout=0) while prep is stalled
+    by an injected per-request delay — and every ACCEPTED request still
+    resolves on close."""
+    faultinject.inject("serve.request", "delay", arg=0.3)
+    eng = _toy_engine(max_batch=2, max_wait=0.005, queue_limit=1, host_workers=1)
+    try:
+        accepted = []
+        with pytest.raises(queue.Full):
+            for i in range(4):  # limit 1 + one in-flight: must refuse by #4
+                accepted.append(
+                    eng.submit(key="A", payload=_toy_payload(3, float(i)), timeout=0)
+                )
+        assert 1 <= len(accepted) <= 3
+    finally:
+        faultinject.clear()  # let the drain run undelayed
+        eng.close()
+    for i, f in enumerate(accepted):
+        np.testing.assert_array_equal(
+            f.result(timeout=5)["y"], np.full((3,), 3.0 * i, np.float32)
+        )
+
+
+def test_engine_slow_request_does_not_stall_others():
+    """A single injected-slow request (serve.request delay on hit 1) must
+    not block later requests: with 2 host workers the fast ones flush and
+    resolve while the slow one is still sleeping."""
+    faultinject.inject("serve.request", "delay", arg=2.0, at=1)
+    with _toy_engine(max_batch=4, max_wait=0.01, host_workers=2) as eng:
+        eng.warmup([("A", payload_spec(_toy_payload(3, 0.0)))])
+        t0 = time.monotonic()
+        slow = eng.submit(key="A", payload=_toy_payload(3, 0.0))
+        fast = [
+            eng.submit(key="A", payload=_toy_payload(3, float(i)))
+            for i in range(1, 4)
+        ]
+        for f in fast:
+            f.result(timeout=5)
+        assert time.monotonic() - t0 < 1.5  # well under the 2 s delay
+        assert not slow.done()
+        slow.result(timeout=10)  # and the slow one still completes
+
+
+def test_engine_crash_fault_fails_only_that_request():
+    faultinject.inject("serve.request", "crash", at=2)
+    with _toy_engine(max_batch=2, max_wait=0.005, host_workers=1) as eng:
+        eng.warmup([("A", payload_spec(_toy_payload(3, 0.0)))])
+        futs = [
+            eng.submit(key="A", payload=_toy_payload(3, float(i)))
+            for i in range(3)
+        ]
+        with pytest.raises(InjectedFault):
+            futs[1].result(timeout=10)
+        for i in (0, 2):
+            np.testing.assert_array_equal(
+                futs[i].result(timeout=10)["y"],
+                np.full((3,), 3.0 * i, np.float32),
+            )
+        stats = eng.report()
+    assert stats["failed"] == 1 and stats["completed"] == 2
+
+
+def test_engine_prep_retry_uses_loader_machinery():
+    """A transiently-failing prep succeeds under ``prep_retries`` (the
+    data loader's `retry_call`); with retries 0 it fails the future."""
+    calls = {"n": 0}
+
+    def flaky_prep(raw):
+        calls["n"] += 1
+        if calls["n"] % 2 == 1:  # every first attempt fails
+            raise OSError("transient decode failure")
+        return ("A", _toy_payload(3, float(raw)))
+
+    with _toy_engine(
+        max_batch=2, max_wait=0.005, host_workers=1,
+        prep_fn=flaky_prep, prep_retries=2, retry_backoff=0.0,
+    ) as eng:
+        fut = eng.submit(7.0)
+        np.testing.assert_array_equal(
+            fut.result(timeout=10)["y"], np.full((3,), 21.0, np.float32)
+        )
+        assert eng.report()["failed"] == 0
+    with _toy_engine(
+        max_batch=2, max_wait=0.005, host_workers=1, prep_fn=flaky_prep
+    ) as eng:
+        fut = eng.submit(7.0)  # calls["n"] odd again: first attempt fails
+        with pytest.raises(OSError, match="transient"):
+            fut.result(timeout=10)
+        assert eng.report()["failed"] == 1
+
+
+def test_engine_submit_after_close_raises():
+    eng = _toy_engine(max_batch=2)
+    eng.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.submit(key="A", payload=_toy_payload(2, 0.0))
+    eng.close()  # idempotent
+
+
+# ----------------------------------------------------------------------
+# the tentpole guarantee: padded batches == per-pair pipeline, bitwise
+
+
+@pytest.mark.parametrize("topk", [0, 8], ids=["dense", "nc_topk8"])
+def test_padded_batch_bitwise_parity(topk):
+    """The engine's numerical contract, dense AND sparse NC band:
+
+    * stacking/padding/readout are EXACT — a served batch returns
+      bitwise what the same compiled program returns on the same padded
+      array (padding rows never perturb real rows);
+    * a lone request (padded to bs 1) is bitwise the per-pair jit;
+    * across different batch sizes results agree to the few-ulp
+      float-associativity of XLA's batch-size-dependent codegen (the
+      only permitted difference — NOT a padding leak);
+    * zero recompiles after warmup under this mixed traffic.
+
+    The patch16 trunk keeps the 8 traces this needs (per-pair + batched
+    references x two buckets + warmup) off the resnet101 compile cost —
+    stack/pad/mask/readout exactness is trunk-independent.
+    """
+    cfg = TINY.replace(feature_extraction_cnn="patch16", nc_topk=topk)
+    params = init_immatchnet(jax.random.PRNGKey(0), cfg)
+    apply_fn = make_serve_match_step(cfg)
+    rng = np.random.RandomState(7)
+
+    def pair(src_hw, tgt_hw):
+        return {
+            "source_image": rng.rand(*src_hw, 3).astype(np.float32),
+            "target_image": rng.rand(*tgt_hw, 3).astype(np.float32),
+        }
+
+    # bucket A x4 (one full batch), bucket B x3 (padded 3 -> 4)
+    payloads = [pair((32, 48), (48, 32)) for _ in range(4)]
+    payloads += [pair((48, 32), (32, 48)) for _ in range(3)]
+    keys = [
+        (p["source_image"].shape, p["target_image"].shape) for p in payloads
+    ]
+
+    ref = jax.jit(apply_fn)
+    per_pair = [
+        np.asarray(ref(params, {k: v[None] for k, v in p.items()})["matches"])[0]
+        for p in payloads
+    ]
+
+    def stacked(plist, pad_to):
+        rows = [p for p in plist] + [plist[-1]] * (pad_to - len(plist))
+        return {
+            name: np.stack([p[name] for p in rows]) for name in plist[0]
+        }
+
+    # same-program references: full bs-4 batch for A, padded bs-4 (3 real
+    # + replicated pad row) for B — what stack/pad/slice must reproduce
+    expected_a = np.asarray(ref(params, stacked(payloads[:4], 4))["matches"])
+    expected_b = np.asarray(ref(params, stacked(payloads[4:], 4))["matches"])[:3]
+
+    # batch_sizes (1, 4): bs 2 is irrelevant to this traffic, and each
+    # avoided warmup trace saves seconds of tier-1 budget
+    with ServeEngine(
+        apply_fn, params, max_batch=4, max_wait=0.05, batch_sizes=(1, 4)
+    ) as eng:
+        eng.warmup(
+            {k: (k, payload_spec(p)) for k, p in zip(keys, payloads)}.values()
+        )
+        warm_traces = eng.compile_count
+        futs = [
+            eng.submit(key=k, payload=p) for k, p in zip(keys, payloads)
+        ]
+        results = [f.result(timeout=120)["matches"] for f in futs]
+        # a lone request flushes alone at the deadline: bs-1 program,
+        # bitwise the per-pair pipeline
+        lone = eng.submit(key=keys[0], payload=payloads[0])
+        lone_result = lone.result(timeout=120)["matches"]
+        stats = eng.report()
+
+    np.testing.assert_array_equal(np.stack(results[:4]), expected_a)
+    np.testing.assert_array_equal(np.stack(results[4:]), expected_b)
+    np.testing.assert_array_equal(lone_result, per_pair[0])
+    for got, want in zip(results, per_pair):  # across batch sizes: ulps
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert stats["recompiles_after_warmup"] == 0
+    assert eng.compile_count == warm_traces
+    assert stats["batches"] == 3 and stats["real_samples"] == 8
+
+
+def test_evaluate_serving_bitwise_matches_evaluate():
+    """The --batch PF-Pascal path: identical per-pair PCK to the
+    sequential eval (same step body, padding masked; the patch16 trunk
+    keeps the compile cost down, as in the parity test), plus stats."""
+    from ncnet_tpu.eval.pf_pascal import evaluate, evaluate_serving
+
+    cfg = TINY.replace(feature_extraction_cnn="patch16")
+    params = init_immatchnet(jax.random.PRNGKey(0), cfg)
+
+    rng = np.random.RandomState(2)
+
+    def mk_batch(n, hw):
+        h, w = hw
+        pts = rng.randint(5, min(h, w) - 5, size=(n, 2, 3)).astype(np.float32)
+        pts[:, :, 2] = -1.0  # padded keypoint slot
+        size = np.tile(np.asarray([h, w, 3], np.float32), (n, 1))
+        return {
+            "source_image": rng.rand(n, h, w, 3).astype(np.float32),
+            "target_image": rng.rand(n, h, w, 3).astype(np.float32),
+            "source_points": pts,
+            "target_points": pts.copy(),
+            "source_im_size": size,
+            "target_im_size": size.copy(),
+            "L_pck": np.full((n, 1), 224.0, np.float32),
+        }
+
+    # square images (the PCK point transfer's default square grid), full
+    # loader batches == the serving cap, so both paths run THE bs-4
+    # program; one bucket keeps the warmup to a single program set
+    # (multi-bucket traffic is covered by the parity test above)
+    loader = [mk_batch(4, (32, 32)), mk_batch(4, (32, 32))]
+    seq = evaluate(params, cfg, loader, verbose=False)
+    srv = evaluate_serving(
+        params, cfg, loader, verbose=False, max_batch=4, max_wait=0.2
+    )
+    assert srv["per_pair"] == seq["per_pair"]  # exact float equality
+    assert srv["n_valid"] == seq["n_valid"]
+    assert srv["pck"] == seq["pck"]
+    assert srv["serve"]["recompiles_after_warmup"] == 0
+    assert srv["serve"]["completed"] == 8
+
+
+# ----------------------------------------------------------------------
+# CLI smoke: scripts/serve.py end to end on a tiny checkpoint
+
+
+def test_serve_cli_smoke(tmp_path):
+    from PIL import Image
+
+    from ncnet_tpu.train.checkpoint import CheckpointData, save_checkpoint
+
+    cfg = TINY.replace(feature_extraction_cnn="patch16")
+    params = init_immatchnet(jax.random.PRNGKey(0), cfg)
+    ckpt = tmp_path / "tiny.msgpack"
+    save_checkpoint(
+        str(ckpt),
+        CheckpointData(config=cfg, params=params, opt_state=None, epoch=0),
+    )
+
+    imgdir = tmp_path / "imgs"
+    imgdir.mkdir()
+    rng = np.random.RandomState(0)
+    for i in range(4):  # consecutive pairing -> 2 requests, one bucket
+        Image.fromarray(
+            rng.randint(0, 255, (48, 64, 3), np.uint8)
+        ).save(imgdir / f"im{i}.png")
+
+    report_path = tmp_path / "report.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [
+            sys.executable, str(REPO / "scripts" / "serve.py"),
+            "--checkpoint", str(ckpt),
+            "--images", str(imgdir),
+            "--image-size", "64",
+            "--concurrency", "2",
+            "--max-batch", "2",
+            "--max-wait-ms", "20",
+            "--report", str(report_path),
+        ],
+        capture_output=True, text=True, env=env, cwd=str(REPO), timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    report = json.loads(report_path.read_text())
+    assert report["mode"] == "serve"
+    assert report["completed"] == 2 and report["failed"] == 0
+    assert report["recompiles_after_warmup"] == 0
+    assert report["buckets"] == 1
+    assert report["pairs_per_s"] > 0
+    assert report["latency_p95_ms"] >= report["latency_p50_ms"]
