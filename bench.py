@@ -95,7 +95,15 @@ import numpy as np
 
 # Kept for external readers (BENCH_r*.json history); == pfpascal anchor.
 V100_EST_PAIRS_PER_SEC = 4.0
-V5E_BF16_PEAK_FLOPS = 197e12
+
+# The FLOP accounting moved into the library (ncnet_tpu.ops.accounting)
+# so the training loop's live MFU gauge and this CLI report the same
+# number; re-exported here for existing importers (tests, older bench
+# JSON tooling).
+from ncnet_tpu.ops.accounting import (  # noqa: E402
+    V5E_BF16_PEAK_FLOPS,
+    train_step_flops,
+)
 
 # Named flagship configs (reference README.md:42,48 — PF-Pascal trains
 # 5-5-5/16-16-1, IVD/InLoc trains 3-3/16-1; both at 400x400 / batch 16).
@@ -141,41 +149,6 @@ CONFIGS = {
         "v100_bounds": (19.0, 64.0),
     },
 }
-
-
-def train_step_flops(batch, kernels, channels, grid=25, feat_ch=1024,
-                     image=400, from_features=False, nc_topk=0):
-    """Analytic FLOPs (2*MACs) per training step.
-
-    Counted: 2 trunk forwards/sample (features reused for the rolled
-    negatives), pos+neg correlation einsums, the symmetric NC stack
-    forward for pos+neg, and its backward (~2x forward; the frozen trunk
-    takes no backward). With ``from_features`` (the feature cache,
-    ncnet_tpu.features) the step contains ZERO backbone ops, so the trunk
-    term drops out and MFU is reported against the reduced count.
-
-    With ``nc_topk`` > 0 (sparse band, ncnet_tpu.sparse) the NC layers
-    run on ``hA*wA * K`` band entries instead of the dense
-    ``hA*wA * hB*wB`` support — the per-layer count becomes
-    ``2 * grid^2 * min(K, grid^2) * k^4 * cin * cout`` — and MFU is
-    reported against the reduced count. The top-K selection, pointer
-    build, and gathers are integer/comparison work and are not counted
-    (the correlation einsum, which the sparse path still runs, is).
-    """
-    resnet101_layer3_224 = 6.5e9  # conv1..layer3 @ 224x224 per image
-    trunk = 2 * resnet101_layer3_224 * (image / 224.0) ** 2
-    if from_features:
-        trunk = 0.0
-    corr = 2 * 2.0 * grid**4 * feat_ch  # pos + neg
-    n_b = grid**2 if not nc_topk else min(int(nc_topk), grid**2)
-    nc_channels = [1, *channels]
-    nc_pass = sum(
-        2.0 * grid**2 * n_b * k**4 * cin * cout
-        for k, cin, cout in zip(kernels, nc_channels[:-1], nc_channels[1:])
-    )
-    nc_fwd = nc_pass * 2 * 2  # symmetric x (pos + neg)
-    nc_bwd = 2 * nc_fwd
-    return batch * (trunk + corr + nc_fwd + nc_bwd)
 
 
 def main():
@@ -254,8 +227,25 @@ def main():
                         "assert. The probes add work — a --sanitize run "
                         "is a diagnostic, NOT a throughput number (the "
                         "JSON is tagged \"sanitized\")")
+    p.add_argument("--telemetry", type=str, default=None, metavar="DIR",
+                   help="write a telemetry run under DIR "
+                        "(ncnet_tpu.telemetry): bench/warmup + "
+                        "bench/timed_chain spans and the headline "
+                        "gauges, renderable with "
+                        "scripts/telemetry_report.py DIR")
     args = p.parse_args()
 
+    from ncnet_tpu import telemetry
+
+    if args.telemetry:
+        telemetry.start(args.telemetry, label="bench")
+    try:
+        _run(args)
+    finally:
+        telemetry.stop()  # no-op without --telemetry
+
+
+def _run(args):
     from ncnet_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache(args.compile_cache)
@@ -353,21 +343,25 @@ def main():
                 "non-finite stage)"
             )
 
+    from ncnet_tpu.telemetry import trace
+
     # Compile + warmup with a per-step D2H sync (the ONLY reliable way to
     # force execution here; block_until_ready is a no-op on this platform).
-    for w in range(2):
-        state, loss = step(state, batch)
-        check_finite(float(loss), f"warmup step {w}")
+    with trace.span("bench/warmup"):
+        for w in range(2):
+            state, loss = step(state, batch)
+            check_finite(float(loss), f"warmup step {w}")
 
     # Timed: steps chain through the state dependency, so ONE final D2H
     # forces the whole sequence; the ~80 ms roundtrip latency of this
     # platform is amortized over n_steps instead of paid per step.
     n_steps = args.steps
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        state, loss = step(state, batch)
-    loss_host = float(loss)
-    dt = time.perf_counter() - t0
+    with trace.span("bench/timed_chain"):
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            state, loss = step(state, batch)
+        loss_host = float(loss)
+        dt = time.perf_counter() - t0
     check_finite(loss_host, f"timed chain ({n_steps} steps)")
     if args.sanitize:
         print(sanitizer.report_text(), flush=True)
@@ -380,6 +374,16 @@ def main():
         nc_topk=args.nc_topk,
     )
     mfu = (step_flops * n_steps / dt) / V5E_BF16_PEAK_FLOPS
+    from ncnet_tpu.telemetry import default_registry
+
+    reg = default_registry()
+    reg.gauge("bench_pairs_per_s", "bench headline throughput").set(
+        pairs_per_sec
+    )
+    reg.gauge("bench_step_ms", "bench mean step time").set(
+        dt / n_steps * 1e3
+    )
+    reg.gauge("bench_mfu", "bench analytic MFU vs v5e bf16 peak").set(mfu)
     sparse_extras = {}
     if args.nc_topk:
         # the dense-vs-band analytic pair: BENCH_r*.json trajectories stay

@@ -97,7 +97,7 @@ def main():
         ),
     }
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     outcome = {"nan_step": None, "first_nonfinite": None,
                "losses_head": [], "loss_last": None}
     for i in range(args.steps):
@@ -105,7 +105,7 @@ def main():
         loss_host = float(loss)
         if i < 10 or (i + 1) % args.log_every == 0:
             print(f"step {i + 1}: loss {loss_host:.6g} "
-                  f"({time.time() - t0:.0f}s)", flush=True)
+                  f"({time.perf_counter() - t0:.0f}s)", flush=True)
         if len(outcome["losses_head"]) < 10:
             outcome["losses_head"].append(loss_host)
         outcome["loss_last"] = loss_host

@@ -18,8 +18,10 @@ requests over a small set of shape buckets, served two ways —
 Pairs are real PNG files on disk (written by this script) so the host
 stage pays real decode work, as serving would. Prints one JSON line with
 sequential_pairs_s, served_pairs_s, speedup, occupancy, and
-p50/p95/p99 latency (serving path) from `timing.percentiles` — the
-PERF.md round-10 numbers. CPU proxy discipline as PR 3/4: the overlap
+p50/p95/p99 latency — both paths now accounted through
+`ncnet_tpu.telemetry` histograms (the engine's own
+``serve_request_latency_seconds`` and a baseline histogram here), the
+one percentile implementation — the PERF.md round-10 numbers. CPU proxy discipline as PR 3/4: the overlap
 and amortization mechanics are platform-independent; absolute ms are
 not.
 
@@ -41,7 +43,10 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from timing import percentiles  # noqa: E402
+from ncnet_tpu.telemetry import (  # noqa: E402
+    DEFAULT_LATENCY_BUCKETS,
+    MetricsRegistry,
+)
 
 
 def write_pngs(root, n_images, sizes, seed=0):
@@ -130,14 +135,18 @@ def main():
                 np.asarray,
                 jitted(params, {k: v[None] for k, v in payload.items()}),
             )
-        seq_lat = []
+        seq_hist = MetricsRegistry().histogram(
+            "sequential_request_latency_seconds",
+            "per-pair baseline latency",
+            buckets=DEFAULT_LATENCY_BUCKETS,
+        )
         t0 = time.perf_counter()
         for pair in requests:
             t_req = time.perf_counter()
             _, payload = prep(pair)
             out = jitted(params, {k: v[None] for k, v in payload.items()})
             jax.tree_util.tree_map(np.asarray, out)
-            seq_lat.append(time.perf_counter() - t_req)
+            seq_hist.observe(time.perf_counter() - t_req)
         seq_wall = time.perf_counter() - t0
 
         # --- batched serving ---------------------------------------------
@@ -181,8 +190,12 @@ def main():
                 fut.result()
             serve_wall = time.perf_counter() - t0
             stats = engine.report()
+            # the engine's OWN latency histogram is the percentile source
+            # now (report()'s latencies_s is a view of the same samples)
+            pct = engine.metrics.get(
+                "serve_request_latency_seconds"
+            ).percentiles()
 
-    pct = percentiles(stats["latencies_s"])
     out = {
         "pairs": args.pairs,
         "concurrency": args.concurrency,
@@ -197,7 +210,7 @@ def main():
         "serve_p50_ms": round(pct["p50"] * 1e3, 1),
         "serve_p95_ms": round(pct["p95"] * 1e3, 1),
         "serve_p99_ms": round(pct["p99"] * 1e3, 1),
-        "seq_p50_ms": round(percentiles(seq_lat)["p50"] * 1e3, 1),
+        "seq_p50_ms": round(seq_hist.percentiles()["p50"] * 1e3, 1),
     }
     print(json.dumps(out))
 

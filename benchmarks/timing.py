@@ -6,12 +6,29 @@ execution — and a D2H roundtrip costs ~75-95 ms, which swamps per-op
 timings. So: every sync is a D2H reduction, and per-op costs come from the
 SLOPE between a short and a long chain of dependent applications inside one
 jit (the sync constant and dispatch overheads cancel).
+
+`percentiles` / `summarize_latencies` are re-export shims: the one
+implementation lives in `ncnet_tpu.telemetry.registry` (the metrics
+registry's histogram snapshots use the same code), kept importable here
+so existing ``from timing import percentiles`` benchmark call sites keep
+working.
 """
 
+import os
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from ncnet_tpu.telemetry.registry import (  # noqa: E402,F401
+    percentiles,
+    summarize_latencies,
+)
 
 
 def sync(out):
@@ -25,19 +42,6 @@ def time_once(fn, *args):
     t0 = time.perf_counter()
     sync(out)
     return time.perf_counter() - t0
-
-
-def percentiles(samples, ps=(50, 95, 99)):
-    """``{'p50': ..., 'p95': ..., 'p99': ...}`` over ``samples`` (seconds
-    or any unit — values pass through), linear interpolation. Empty input
-    gives NaNs rather than raising: a benchmark that timed nothing should
-    still emit a well-formed report."""
-    import numpy as np
-
-    if len(samples) == 0:
-        return {f"p{p}": float("nan") for p in ps}
-    arr = np.asarray(samples, dtype=np.float64)
-    return {f"p{p}": float(np.percentile(arr, p)) for p in ps}
 
 
 def time_chain(make_chain, n_lo=1, n_hi=6, iters=3):

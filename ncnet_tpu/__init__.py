@@ -27,6 +27,7 @@ from ncnet_tpu import (
     ops,
     parallel,
     resilience,
+    telemetry,
     train,
     utils,
 )
@@ -43,6 +44,7 @@ __all__ = [
     "ops",
     "parallel",
     "resilience",
+    "telemetry",
     "train",
     "utils",
 ]

@@ -29,6 +29,12 @@ arXiv:2004.10566, the low-precision normalization fragility):
                             program retraces/recompiles every iteration —
                             the jit-cache-churn hazard the serving engine's
                             warm AOT executables exist to avoid
+  wall-clock-timing         durations computed by subtracting ``time.time()``
+                            readings: wall clock is not monotonic (NTP
+                            steps/slews), so logged latencies can go
+                            negative — use ``time.perf_counter`` (the
+                            telemetry tracer's contract); wall time is for
+                            TIMESTAMP fields only
 
 All rules are intentionally conservative (intra-module reasoning only, one
 level of name expansion): a finding should mean something; the escape hatch
@@ -788,4 +794,60 @@ def mutable_default_arg(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
                 yield default, (
                     "mutable default argument is shared across calls; "
                     "default to None and construct inside the function"
+                )
+
+
+# --- wall-clock-timing ------------------------------------------------------
+
+
+def _is_wall_clock_call(ctx: ModuleContext, expr: ast.AST) -> bool:
+    return (
+        isinstance(expr, ast.Call)
+        and ctx.canonical(expr.func) == "time.time"
+    )
+
+
+@rule(
+    "wall-clock-timing",
+    "warning",
+    doc="Duration computed by subtracting `time.time()` readings: the wall "
+        "clock is not monotonic — an NTP step between the two reads "
+        "produces a negative or wildly wrong latency that then lands in "
+        "logs and percentile reports. Use `time.perf_counter()` (the "
+        "`ncnet_tpu.telemetry` tracer's clock contract). `time.time()` is "
+        "for TIMESTAMP fields (epoch anchors, event `ts`), never a "
+        "duration operand; genuine wall-time arithmetic gets a reasoned "
+        "suppression.",
+)
+def wall_clock_timing(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    if ctx.is_test:
+        return
+    # one `seen` across scopes: the module walk revisits every function
+    # body, and a BinOp must report once no matter which scope finds it
+    seen: Set[ast.AST] = set()
+    for fn in list(_func_nodes(ctx.tree)) + [ctx.tree]:
+        names = _assignments(fn)
+
+        def expand(expr: ast.AST) -> ast.AST:
+            # one level of `t0 = time.time()` name expansion, the same
+            # conservatism as unguarded-division
+            if isinstance(expr, ast.Name) and expr.id in names:
+                return names[expr.id]
+            return expr
+
+        for node in ast.walk(fn):
+            if (
+                not isinstance(node, ast.BinOp)
+                or not isinstance(node.op, ast.Sub)
+                or node in seen
+            ):
+                continue
+            seen.add(node)
+            if _is_wall_clock_call(ctx, expand(node.left)) or \
+                    _is_wall_clock_call(ctx, expand(node.right)):
+                yield node, (
+                    "duration from time.time() subtraction: wall clock "
+                    "is not monotonic (NTP steps make latencies negative); "
+                    "time with time.perf_counter(), keep time.time() for "
+                    "timestamp fields only"
                 )

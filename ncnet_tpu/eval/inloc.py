@@ -41,6 +41,8 @@ from ncnet_tpu.ops.matches import corr_to_matches
 # module (ncnet_tpu.serve.buckets) so the serving engine and this dump
 # agree on the bucket set; re-exported here for existing callers
 from ncnet_tpu.serve.buckets import SCALE_FACTOR, quantized_resize_shape
+from ncnet_tpu.telemetry import trace
+from ncnet_tpu.telemetry.registry import default_registry
 
 __all__ = [
     "SCALE_FACTOR",
@@ -554,13 +556,21 @@ def dump_matches(
         inflight = collections.deque()
         pipeline_depth = 1
 
+        m_pairs = default_registry().counter(
+            "eval_pairs_total", "image pairs evaluated"
+        )
+
         def consume():
             q, idx, out, shp = inflight.popleft()
-            xa, ya, xb, yb, score = match_pair(
-                None, None, None, None, k_size, stride,
-                both_directions, flip_direction, precomputed=out,
-                shapes=shp,
-            )
+            # the pair's readout span: D2H of the match tensor plus the
+            # host-side sort/dedup (dispatch overlaps it — see below)
+            with trace.span("eval/pair_readout"):
+                xa, ya, xb, yb, score = match_pair(
+                    None, None, None, None, k_size, stride,
+                    both_directions, flip_direction, precomputed=out,
+                    shapes=shp,
+                )
+            m_pairs.inc()
             matches = matrices[q]
             n = min(len(xa), n_slots)
             matches[0, idx, :n, 0] = xa[:n]
@@ -594,7 +604,8 @@ def dump_matches(
             src = take()
             tgt = take()
             for idx in range(n_panos):
-                out = jitted(params, src, tgt)  # async dispatch
+                with trace.span("eval/pair_dispatch"):
+                    out = jitted(params, src, tgt)  # async dispatch
                 if concat:
                     # start the result's D2H the moment compute finishes,
                     # without blocking this thread
@@ -693,16 +704,20 @@ def _dump_matches_from_store(
         q_shape = (1, qfeat.shape[1] * stride, qfeat.shape[2] * stride, 3)
         matches = np.zeros((1, n_panos, n_slots, 5))
         for idx in range(n_panos):
-            pfeat = pano_features(_to_str(db[q][1].ravel()[idx]))
-            p_shape = (
-                1, pfeat.shape[1] * stride, pfeat.shape[2] * stride, 3
-            )
-            out = match_fn(params, qfeat, pfeat)
-            xa, ya, xb, yb, score = match_pair(
-                None, None, None, None, k_size, stride,
-                both_directions, flip_direction, precomputed=out,
-                shapes=(q_shape, p_shape),
-            )
+            with trace.span("eval/pair"):
+                pfeat = pano_features(_to_str(db[q][1].ravel()[idx]))
+                p_shape = (
+                    1, pfeat.shape[1] * stride, pfeat.shape[2] * stride, 3
+                )
+                out = match_fn(params, qfeat, pfeat)
+                xa, ya, xb, yb, score = match_pair(
+                    None, None, None, None, k_size, stride,
+                    both_directions, flip_direction, precomputed=out,
+                    shapes=(q_shape, p_shape),
+                )
+            default_registry().counter(
+                "eval_pairs_total", "image pairs evaluated"
+            ).inc()
             n = min(len(xa), n_slots)
             matches[0, idx, :n, 0] = xa[:n]
             matches[0, idx, :n, 1] = ya[:n]

@@ -19,6 +19,8 @@ from ncnet_tpu.models.immatchnet import immatchnet_apply
 from ncnet_tpu.ops.coords import points_to_pixel_coords, points_to_unit_coords
 from ncnet_tpu.ops.matches import bilinear_point_transfer, corr_to_matches
 from ncnet_tpu.ops.metrics import pck
+from ncnet_tpu.telemetry import trace
+from ncnet_tpu.telemetry.registry import default_registry
 
 
 # the batch keys the PCK step consumes (and the serving payload carries)
@@ -75,13 +77,22 @@ def evaluate(params, config, loader, alpha=0.1, verbose=True):
     Returns ``{'pck': mean, 'per_pair': [...], 'n_valid': int}``.
     """
     step = make_pck_step(config, alpha)
+    m_pairs = default_registry().counter(
+        "eval_pairs_total", "image pairs evaluated"
+    )
     per_pair = []
     for i, batch in enumerate(loader):
-        jbatch = {
-            k: jnp.asarray(v) for k, v in batch.items() if k in PCK_BATCH_KEYS
-        }
-        scores = np.asarray(step(params, jbatch))
+        # one span per dispatched batch; np.asarray is the D2H sync, so
+        # the span covers real device execution, not just dispatch
+        with trace.span("eval/pck_batch"):
+            jbatch = {
+                k: jnp.asarray(v)
+                for k, v in batch.items()
+                if k in PCK_BATCH_KEYS
+            }
+            scores = np.asarray(step(params, jbatch))
         per_pair.extend(scores.tolist())
+        m_pairs.inc(len(scores))
         if verbose:
             print(f"batch [{i + 1}/{len(loader)}]", flush=True)
     return _summarize(per_pair)

@@ -11,6 +11,8 @@ import time
 import numpy as np
 
 from ncnet_tpu.models.immatchnet import extract_features
+from ncnet_tpu.telemetry import trace
+from ncnet_tpu.telemetry.registry import default_registry
 
 
 def make_batch_extractor(params, config):
@@ -51,28 +53,43 @@ def populate_store(store, params, config, dataset, batch_size=8,
         return 0
     extractor = make_batch_extractor(params, config)
     out_dtype = store.dtype
-    t0 = time.time()
+    metrics = default_registry()
+    m_shards = metrics.counter(
+        "feature_shards_written_total", "feature shards durably written"
+    )
+    m_bytes = metrics.counter(
+        "feature_shard_bytes_total", "feature payload bytes written"
+    )
+    t0 = time.perf_counter()
     done = 0
     for lo in range(0, len(missing), batch_size):
         group = missing[lo : lo + batch_size]
-        samples = [dataset[i] for i in group]
-        pad = batch_size - len(group)
-        if pad:
-            samples = samples + [samples[-1]] * pad
-        src = np.stack([s["source_image"] for s in samples])
-        tgt = np.stack([s["target_image"] for s in samples])
-        feats = np.asarray(extractor(np.concatenate([src, tgt], axis=0)))
+        with trace.span("features/extract_batch"):
+            samples = [dataset[i] for i in group]
+            pad = batch_size - len(group)
+            if pad:
+                samples = samples + [samples[-1]] * pad
+            src = np.stack([s["source_image"] for s in samples])
+            tgt = np.stack([s["target_image"] for s in samples])
+            feats = np.asarray(
+                extractor(np.concatenate([src, tgt], axis=0))
+            )
         if feats.dtype != out_dtype:
             raise RuntimeError(
                 f"extractor produced {feats.dtype} but the store holds "
                 f"{out_dtype}; the config does not match the manifest"
             )
         feats_src, feats_tgt = feats[:batch_size], feats[batch_size:]
-        for j, idx in enumerate(group):
-            store.put(idx, feats_src[j], feats_tgt[j])
+        with trace.span("features/store_put"):
+            for j, idx in enumerate(group):
+                store.put(idx, feats_src[j], feats_tgt[j])
+                m_shards.inc()
+                m_bytes.inc(
+                    int(feats_src[j].nbytes) + int(feats_tgt[j].nbytes)
+                )
         done += len(group)
         if log_every and (done // batch_size) % log_every == 0:
-            rate = done / max(time.time() - t0, 1e-9)
+            rate = done / max(time.perf_counter() - t0, 1e-9)
             print(
                 f"[features] {done}/{len(missing)} pairs extracted "
                 f"({rate:.1f} pairs/s)",

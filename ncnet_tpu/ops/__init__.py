@@ -4,6 +4,11 @@ Every op here mirrors math documented in SURVEY.md §2 against the reference
 (GrumpyZhou/ncnet), but is written channels-last and XLA-first.
 """
 
+from ncnet_tpu.ops.accounting import (
+    V5E_BF16_PEAK_FLOPS,
+    train_step_flops,
+    train_step_flops_for_batch,
+)
 from ncnet_tpu.ops.band import (
     band_coverage,
     band_gather_neighbors,
@@ -40,6 +45,9 @@ from ncnet_tpu.ops.metrics import pck
 from ncnet_tpu.ops.norm import feature_l2norm
 
 __all__ = [
+    "V5E_BF16_PEAK_FLOPS",
+    "train_step_flops",
+    "train_step_flops_for_batch",
     "band_coverage",
     "band_gather_neighbors",
     "band_neighbor_pointers",

@@ -1,0 +1,83 @@
+"""Analytic FLOP accounting for the training step, and the MFU anchor.
+
+Moved out of ``bench.py`` so the library can compute model-FLOP
+utilization live: the training loop's telemetry gauge
+(``train_mfu``) and the bench CLI share this one count — the reported
+MFU is the same number whether it comes from a benchmark run or from a
+``--telemetry`` training run.
+
+Pure arithmetic over config-shaped integers; no jax, no module state
+(the `ops` contract).
+"""
+
+# v5e bf16 peak per chip — the MFU denominator (bench.py's anchor).
+V5E_BF16_PEAK_FLOPS = 197e12
+
+
+def train_step_flops(batch, kernels, channels, grid=25, feat_ch=1024,
+                     image=400, from_features=False, nc_topk=0):
+    """Analytic FLOPs (2*MACs) per training step.
+
+    Counted: 2 trunk forwards/sample (features reused for the rolled
+    negatives), pos+neg correlation einsums, the symmetric NC stack
+    forward for pos+neg, and its backward (~2x forward; the frozen trunk
+    takes no backward). With ``from_features`` (the feature cache,
+    ncnet_tpu.features) the step contains ZERO backbone ops, so the trunk
+    term drops out and MFU is reported against the reduced count.
+
+    With ``nc_topk`` > 0 (sparse band, ncnet_tpu.sparse) the NC layers
+    run on ``hA*wA * K`` band entries instead of the dense
+    ``hA*wA * hB*wB`` support — the per-layer count becomes
+    ``2 * grid^2 * min(K, grid^2) * k^4 * cin * cout`` — and MFU is
+    reported against the reduced count. The top-K selection, pointer
+    build, and gathers are integer/comparison work and are not counted
+    (the correlation einsum, which the sparse path still runs, is).
+    """
+    resnet101_layer3_224 = 6.5e9  # conv1..layer3 @ 224x224 per image
+    trunk = 2 * resnet101_layer3_224 * (image / 224.0) ** 2
+    if from_features:
+        trunk = 0.0
+    corr = 2 * 2.0 * grid**4 * feat_ch  # pos + neg
+    n_b = grid**2 if not nc_topk else min(int(nc_topk), grid**2)
+    nc_channels = [1, *channels]
+    nc_pass = sum(
+        2.0 * grid**2 * n_b * k**4 * cin * cout
+        for k, cin, cout in zip(kernels, nc_channels[:-1], nc_channels[1:])
+    )
+    nc_fwd = nc_pass * 2 * 2  # symmetric x (pos + neg)
+    nc_bwd = 2 * nc_fwd
+    return batch * (trunk + corr + nc_fwd + nc_bwd)
+
+
+def train_step_flops_for_batch(config, batch, from_features=False):
+    """`train_step_flops` derived from a config + a concrete batch dict.
+
+    ``batch`` maps names to ``[b, h, w, ...]`` arrays: images
+    (``source_image``) on the raw-pixel path, ``[b, gh, gw, c]`` feature
+    maps (``source_features``) on the cached path. The trunk term uses
+    the image side (stride-16 backbone: grid = side // 16); the analytic
+    count assumes a square grid, which both the training datasets and
+    the synthetic benches satisfy.
+    """
+    from_features = from_features or "source_features" in batch
+    arr = (
+        batch["source_features"]
+        if "source_features" in batch
+        else batch["source_image"]
+    )
+    b = int(arr.shape[0])
+    if from_features:
+        grid, feat_ch, image = int(arr.shape[1]), int(arr.shape[-1]), 0
+    else:
+        image = int(arr.shape[1])
+        grid, feat_ch = max(image // 16, 1), 1024
+    return train_step_flops(
+        b,
+        config.ncons_kernel_sizes,
+        config.ncons_channels,
+        grid=grid,
+        feat_ch=feat_ch,
+        image=image,
+        from_features=from_features,
+        nc_topk=int(getattr(config, "nc_topk", 0)),
+    )

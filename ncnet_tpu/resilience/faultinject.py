@@ -25,6 +25,10 @@ the failures the recovery paths claim to survive:
                                 (`ncnet_tpu.serve`): fires on a worker thread
                                 before decode/resize, so delay/crash exercises
                                 slow or failed requests without stalling others
+  ``telemetry.write``           telemetry exporters (`ncnet_tpu.telemetry`):
+                                before each JSONL event-log flush, and mid-write
+                                of the ``.prom`` snapshot temp file — a crash
+                                must leave at most a torn trailing JSONL line
   ============================  =================================================
 
 Actions: ``crash`` raises :class:`InjectedFault` (unwinds normally, finally

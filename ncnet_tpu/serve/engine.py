@@ -49,6 +49,12 @@ import jax
 from ncnet_tpu.data.loader import retry_call
 from ncnet_tpu.resilience import faultinject
 from ncnet_tpu.serve.batcher import MicroBatcher, Request, default_batch_sizes
+from ncnet_tpu.telemetry import trace
+from ncnet_tpu.telemetry.registry import (
+    DEFAULT_LATENCY_BUCKETS,
+    MetricsRegistry,
+    percentiles,
+)
 
 _SENTINEL = object()
 
@@ -126,6 +132,7 @@ class ServeEngine:
         retry_backoff=0.05,
         readout_depth=2,
         compile_cache_dir=None,
+        registry=None,
     ):
         if compile_cache_dir is not None:
             from ncnet_tpu.utils.compile_cache import enable_compile_cache
@@ -160,23 +167,64 @@ class ServeEngine:
         self._compile_lock = threading.Lock()
         self._warm = False
 
-        self._stats_lock = threading.Lock()
-        self._stats = {
-            "submitted": 0,
-            "completed": 0,
-            "failed": 0,
-            "batches": 0,
-            "real_samples": 0,
-            "padded_samples": 0,
-            "recompiles_after_warmup": 0,
-            "latencies_s": [],
-        }
-
         self._submit_q = queue.Queue(maxsize=queue_limit)
         self._batch_q = queue.Queue()
         self._readout_q = queue.Queue(maxsize=readout_depth)
         self._closed = False
         self._stop_dispatch = threading.Event()
+
+        # Engine stats live in a telemetry metrics registry; `report()`
+        # is a VIEW over it. Private per engine by default (co-resident
+        # engines and tests must not share totals); pass ``registry=``
+        # (e.g. the telemetry session's) to publish into a shared one.
+        self.metrics = registry if registry is not None else MetricsRegistry()
+        m = self.metrics
+        self._m_submitted = m.counter(
+            "serve_requests_submitted_total",
+            "requests accepted by submit()",
+        )
+        self._m_completed = m.counter(
+            "serve_requests_completed_total",
+            "requests resolved with a result",
+        )
+        self._m_failed = m.counter(
+            "serve_requests_failed_total",
+            "requests resolved with an exception",
+        )
+        self._m_batches = m.counter(
+            "serve_batches_total", "device batches dispatched"
+        )
+        self._m_real = m.counter(
+            "serve_samples_real_total", "real rows across served batches"
+        )
+        self._m_padded = m.counter(
+            "serve_samples_padded_total",
+            "padded rows across served batches",
+        )
+        self._m_recompiles = m.counter(
+            "serve_recompiles_after_warmup_total",
+            "live-request compiles after warmup (must stay 0)",
+        )
+        self._m_latency = m.histogram(
+            "serve_request_latency_seconds",
+            "submit-to-result latency",
+            buckets=DEFAULT_LATENCY_BUCKETS,
+        )
+        self._m_batch_size = m.histogram(
+            "serve_batch_real_size",
+            "real rows per dispatched batch",
+            buckets=tuple(float(b) for b in self.batch_sizes),
+        )
+        # Sampled gauges: the truth lives in the queue / the counters,
+        # the gauges read it at scrape time.
+        m.gauge(
+            "serve_submit_queue_depth",
+            "requests waiting in the bounded submit queue",
+        ).set_fn(self._submit_q.qsize)
+        m.gauge(
+            "serve_mean_occupancy",
+            "cumulative real/padded row ratio across served batches",
+        ).set_fn(self._mean_occupancy)
 
         self._workers = [
             threading.Thread(
@@ -214,8 +262,7 @@ class ServeEngine:
             exe = self._compiled.get(ck)
             if exe is None:
                 if live and self._warm:
-                    with self._stats_lock:
-                        self._stats["recompiles_after_warmup"] += 1
+                    self._m_recompiles.inc()
                 exe = self._jit.lower(
                     self._params, self._specs(key, bs, pspec)
                 ).compile()
@@ -272,8 +319,7 @@ class ServeEngine:
             self._submit_q.put_nowait(item)  # queue.Full on backpressure
         else:
             self._submit_q.put(item, timeout=timeout)
-        with self._stats_lock:
-            self._stats["submitted"] += 1
+        self._m_submitted.inc()
         return fut
 
     def _prep_loop(self):
@@ -283,19 +329,21 @@ class ServeEngine:
                 return
             raw, fut, t_submit = item
             try:
-                # the fault point fires ONCE per request (never retried:
-                # an injected crash must fail deterministically); the
-                # prep itself gets the loader's transient-I/O retry
-                faultinject.fire("serve.request")
-                key, payload = retry_call(
-                    lambda: (
-                        self._prep_fn(raw)
-                        if self._prep_fn is not None
-                        else raw
-                    ),
-                    self._prep_retries,
-                    self._retry_backoff,
-                )
+                with trace.span("serve/prep"):
+                    # the fault point fires ONCE per request (never
+                    # retried: an injected crash must fail
+                    # deterministically); the prep itself gets the
+                    # loader's transient-I/O retry
+                    faultinject.fire("serve.request")
+                    key, payload = retry_call(
+                        lambda: (
+                            self._prep_fn(raw)
+                            if self._prep_fn is not None
+                            else raw
+                        ),
+                        self._prep_retries,
+                        self._retry_backoff,
+                    )
             except BaseException as exc:  # a failed request fails ALONE
                 self._fail(fut, exc)
                 continue
@@ -327,6 +375,10 @@ class ServeEngine:
                     return
 
     def _dispatch(self, batch):
+        with trace.span("serve/dispatch"):
+            self._dispatch_inner(batch)
+
+    def _dispatch_inner(self, batch):
         try:
             reqs = batch.requests
             names = sorted(reqs[0].payload)
@@ -359,53 +411,59 @@ class ServeEngine:
             if item is _SENTINEL:
                 return
             batch, out = item
-            try:
-                host = jax.tree_util.tree_map(np.asarray, out)
-            except BaseException as exc:
+            with trace.span("serve/readout"):
+                try:
+                    host = jax.tree_util.tree_map(np.asarray, out)
+                except BaseException as exc:
+                    for r in batch.requests:
+                        self._fail(r.future, exc)
+                    continue
+                now = time.monotonic()
+                n = len(batch.requests)
+                self._m_batches.inc()
+                self._m_real.inc(n)
+                self._m_padded.inc(batch.pad_to)
+                self._m_completed.inc(n)
+                self._m_batch_size.observe(n)
                 for r in batch.requests:
-                    self._fail(r.future, exc)
-                continue
-            now = time.monotonic()
-            n = len(batch.requests)
-            with self._stats_lock:
-                self._stats["batches"] += 1
-                self._stats["real_samples"] += n
-                self._stats["padded_samples"] += batch.pad_to
-                self._stats["completed"] += n
-                self._stats["latencies_s"].extend(
-                    now - r.t_submit for r in batch.requests
-                )
-            for i, r in enumerate(batch.requests):
-                # padding masked here: only rows [0, n) are ever read
-                r.future.set_result(
-                    jax.tree_util.tree_map(lambda a: a[i], host)
-                )
+                    self._m_latency.observe(now - r.t_submit)
+                for i, r in enumerate(batch.requests):
+                    # padding masked here: only rows [0, n) are ever read
+                    r.future.set_result(
+                        jax.tree_util.tree_map(lambda a: a[i], host)
+                    )
 
     def _fail(self, fut, exc):
-        with self._stats_lock:
-            self._stats["failed"] += 1
+        self._m_failed.inc()
         fut.set_exception(exc)
 
     # ------------------------------------------------------------------
     # lifecycle / accounting
 
+    def _mean_occupancy(self):
+        padded = self._m_padded.value
+        return self._m_real.value / padded if padded else float("nan")
+
     def report(self):
         """Snapshot of serving stats: counts, mean batch occupancy,
-        latency percentiles, and the compile accounting."""
-        with self._stats_lock:
-            s = dict(self._stats)
-            lat = list(s.pop("latencies_s"))
-        s["mean_occupancy"] = (
-            s["real_samples"] / s["padded_samples"]
-            if s["padded_samples"]
-            else float("nan")
-        )
+        latency percentiles, and the compile accounting. A VIEW over
+        ``self.metrics`` — the same totals a telemetry session or a
+        Prometheus scrape of the registry sees."""
+        lat = self._m_latency.samples
+        s = {
+            "submitted": self._m_submitted.value,
+            "completed": self._m_completed.value,
+            "failed": self._m_failed.value,
+            "batches": self._m_batches.value,
+            "real_samples": self._m_real.value,
+            "padded_samples": self._m_padded.value,
+            "recompiles_after_warmup": self._m_recompiles.value,
+        }
+        s["mean_occupancy"] = self._mean_occupancy()
         s["compiles"] = self._trace_count
         s["compiled_programs"] = len(self._compiled)
-        for p in (50, 95, 99):
-            s[f"latency_p{p}_ms"] = (
-                float(np.percentile(lat, p)) * 1e3 if lat else float("nan")
-            )
+        for p, v in percentiles(lat).items():
+            s[f"latency_{p}_ms"] = v * 1e3
         s["latencies_s"] = lat
         return s
 

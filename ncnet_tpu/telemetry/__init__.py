@@ -1,0 +1,67 @@
+"""Unified telemetry: tracing, metrics, and profiling for every surface.
+
+The one observability plane over the five instrumented surfaces — train
+loop, serving engine, eval pipelines, feature extraction, and
+checkpointing — replacing their per-surface ad-hoc dicts (production TPU
+stacks report throughput/latency/utilization side by side; PAPERS.md,
+the Gemma-on-TPU serving study):
+
+  * `registry` — counters / gauges / explicit-bucket histograms
+    (`MetricsRegistry`), plus `percentiles` / `summarize_latencies`
+    as THE latency-summary implementation (``benchmarks/timing.py``
+    re-exports them);
+  * `trace` — ``with trace.span("step/device_compute"):`` spans on
+    monotonic clocks, thread-safe and nestable, an exact no-op
+    singleton when disabled;
+  * `export` — append-only JSONL event log (durable-append discipline,
+    ``telemetry.write`` fault point) and Prometheus text snapshots;
+  * `session` — ``start(dir)`` / ``stop()``, the ``--telemetry DIR``
+    contract: one run produces ``events.jsonl`` + ``metrics.prom``,
+    rendered by ``scripts/telemetry_report.py``;
+  * `profiler` — the `jax.profiler` capture window
+    (``--profile-dir DIR --profile-steps A:B``).
+
+Import-light by contract (stdlib + numpy; jax only inside `profiler`
+methods): hot paths import it at instrumentation points and the report
+CLI imports it without a device runtime.
+"""
+
+from ncnet_tpu.telemetry import export, profiler, registry, session, trace
+from ncnet_tpu.telemetry.export import JsonlWriter, read_events, write_prometheus
+from ncnet_tpu.telemetry.profiler import ProfileWindow, parse_steps
+from ncnet_tpu.telemetry.registry import (
+    DEFAULT_LATENCY_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    percentiles,
+    summarize_latencies,
+)
+from ncnet_tpu.telemetry.session import TelemetrySession, active, start, stop
+
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "JsonlWriter",
+    "MetricsRegistry",
+    "ProfileWindow",
+    "TelemetrySession",
+    "active",
+    "default_registry",
+    "export",
+    "parse_steps",
+    "percentiles",
+    "profiler",
+    "read_events",
+    "registry",
+    "session",
+    "start",
+    "stop",
+    "summarize_latencies",
+    "trace",
+    "write_prometheus",
+]

@@ -1,0 +1,138 @@
+"""Exporters: append-only JSONL event log + Prometheus text snapshot.
+
+Two formats for two consumers:
+
+  * **JSONL** (`JsonlWriter`) — the durable event stream
+    `scripts/telemetry_report.py` renders. Append discipline follows
+    `resilience.durable`: events buffer in memory and flush as
+    COMPLETE lines followed by ``fsync``, so a preemption mid-run
+    loses at most the unflushed tail and can tear at most the final
+    line — which `read_events` skips, the same walk-back-past-damage
+    posture as `durable.latest_valid`. Every physical write first
+    fires the ``telemetry.write`` fault point (`resilience.faultinject`)
+    so tests can prove the crash behavior instead of asserting it.
+  * **Prometheus text exposition** (`write_prometheus`) — a
+    point-in-time ``.prom`` scrape snapshot of a `MetricsRegistry`,
+    written via `durable.durable_write_bytes` (atomic rename + sidecar
+    digest), so a scraper never reads a torn snapshot.
+"""
+
+import json
+import os
+import threading
+import time
+
+from ncnet_tpu.resilience import durable, faultinject
+
+SCHEMA_VERSION = 1
+
+# Canonical file names inside a ``--telemetry DIR`` run directory.
+EVENTS_NAME = "events.jsonl"
+PROM_NAME = "metrics.prom"
+
+
+def _json_default(obj):
+    # numpy scalars and similar reach the sink from device-adjacent code
+    for attr in ("item",):
+        fn = getattr(obj, attr, None)
+        if callable(fn):
+            return fn()
+    return repr(obj)
+
+
+class JsonlWriter:
+    """Append-only JSONL sink with complete-line durable flushes."""
+
+    def __init__(self, path, flush_every=256):
+        self.path = path
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._lock = threading.Lock()
+        self._pending = []
+        self._flush_every = flush_every
+        self._f = open(path, "ab")
+        self._closed = False
+
+    def write(self, event):
+        line = (
+            json.dumps(event, sort_keys=True, default=_json_default) + "\n"
+        ).encode("utf-8")
+        with self._lock:
+            if self._closed:
+                return  # late events from draining threads are dropped
+            self._pending.append(line)
+            if len(self._pending) >= self._flush_every:
+                self._flush_locked()
+
+    def flush(self):
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self):
+        if not self._pending:
+            return
+        blob = b"".join(self._pending)
+        faultinject.fire(
+            "telemetry.write", {"path": self.path, "nbytes": len(blob)}
+        )
+        self._f.write(blob)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        # cleared only after the durable write: a raised flush (injected
+        # crash, ENOSPC) keeps the events pending for the next attempt
+        self._pending = []
+
+    def close(self):
+        with self._lock:
+            if self._closed:
+                return
+            self._flush_locked()
+            self._closed = True
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+
+def read_events(path):
+    """Parse a JSONL event log; skips blank/torn lines (a crash mid-append
+    can tear at most the trailing line — see `JsonlWriter`)."""
+    events = []
+    with open(path, "rb") as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                events.append(json.loads(raw.decode("utf-8")))
+            except (ValueError, UnicodeDecodeError):
+                continue
+    return events
+
+
+def metric_events(registry, ts=None):
+    """One ``{"type": "metric", ...}`` event per registered metric — the
+    JSONL form of a registry snapshot."""
+    stamp = time.time() if ts is None else ts
+    return [
+        {"type": "metric", "name": name, "ts": stamp, **snap}
+        for name, snap in registry.snapshot().items()
+    ]
+
+
+def write_prometheus(path, registry):
+    """Durably write the registry's text exposition; returns bytes
+    written."""
+    blob = registry.to_prometheus().encode("utf-8")
+    durable.durable_write_bytes(
+        path, blob,
+        write_point="telemetry.write",
+        rename_point=None,
+        bytes_point=None,
+    )
+    return len(blob)

@@ -1,0 +1,75 @@
+"""`jax.profiler` capture hook: a programmatic trace window over steps.
+
+`ProfileWindow` owns the start/stop logic the training loop used to
+inline: trace steps ``[start, stop)`` into ``profile_dir`` (viewable
+with tensorboard/xprof), syncing the device before the trace closes —
+``block_until_ready`` does not block on the tunneled platform (see
+``bench.py``), so the caller supplies a D2H ``sync`` callable and the
+window runs it before ``stop_trace``.
+
+CLI form: ``--profile-dir DIR --profile-steps A:B`` (parse the window
+with `parse_steps`). jax imports stay inside methods so this module —
+and the telemetry package — import without jax (the report CLI needs
+that).
+"""
+
+
+def parse_steps(spec):
+    """``"A:B"`` -> ``(A, B)`` with ``0 <= A < B``."""
+    try:
+        a_s, b_s = str(spec).split(":")
+        a, b = int(a_s), int(b_s)
+    except ValueError:
+        raise ValueError(
+            f"--profile-steps wants 'A:B' (e.g. '3:8'), got {spec!r}"
+        ) from None
+    if a < 0 or b <= a:
+        raise ValueError(f"--profile-steps window must have 0 <= A < B, got {spec!r}")
+    return (a, b)
+
+
+class ProfileWindow:
+    """Start/stop one `jax.profiler` trace over a step interval.
+
+    ``on_step(i, sync=...)`` is called once per step with the global step
+    index; the window opens at ``steps[0]``, closes at ``steps[1]``, and
+    captures at most once per process. With ``profile_dir=None`` every
+    call is a no-op, so the loop keeps the hook unconditionally.
+    """
+
+    def __init__(self, profile_dir, steps=(3, 8)):
+        self.profile_dir = profile_dir
+        self.start_step, self.stop_step = steps
+        self._active = False
+        self._done = profile_dir is None
+
+    @property
+    def active(self):
+        return self._active
+
+    def on_step(self, step, sync=None):
+        if self._done:
+            return
+        if not self._active:
+            if step == self.start_step:
+                import jax
+
+                jax.profiler.start_trace(self.profile_dir)
+                self._active = True
+        elif step >= self.stop_step:
+            self.close(sync)
+
+    def close(self, sync=None):
+        """Stop an open trace (idempotent); runs ``sync`` first so the
+        device finishes the profiled steps before the trace file closes."""
+        if self._active:
+            if sync is not None:
+                sync()
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            print(
+                f"profile trace written to {self.profile_dir}", flush=True
+            )
+        self._done = True

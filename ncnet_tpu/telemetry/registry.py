@@ -1,0 +1,303 @@
+"""Metrics registry: counters, gauges, explicit-bucket histograms.
+
+One metric vocabulary for every surface (train loop, serving engine,
+eval pipelines, feature extraction, checkpointing), replacing the
+per-surface ad-hoc dicts. Three Prometheus-shaped metric kinds:
+
+  * `Counter` — monotonically non-decreasing totals (``inc`` rejects
+    negative deltas by contract, so a counter can never run backwards);
+  * `Gauge` — a point-in-time value, either ``set`` explicitly or backed
+    by a callback (``set_fn``) sampled at read time — the queue-depth
+    idiom, where the truth lives in the queue, not in the metric;
+  * `Histogram` — explicit upper-bound buckets (``le``-inclusive, the
+    Prometheus convention) PLUS retained raw samples, so snapshots carry
+    exact p50/p95/p99 instead of bucket-interpolated estimates. Latency
+    histograms default to `DEFAULT_LATENCY_BUCKETS` (seconds).
+
+`percentiles` / `summarize_latencies` here are THE implementation — the
+microbenchmarks' ``benchmarks/timing.py`` re-exports them as shims.
+
+Like `resilience` and `analysis`, this module must stay import-light
+(stdlib + numpy, no jax): the report CLI and the hot paths that import
+it cannot afford a jax import.
+"""
+
+import bisect
+import math
+import re
+import threading
+
+# Upper bounds in seconds for request/step latencies: sub-ms host work up
+# through multi-second cold paths. The +Inf bucket is implicit.
+DEFAULT_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def percentiles(samples, ps=(50, 95, 99)):
+    """``{'p50': ..., 'p95': ..., 'p99': ...}`` over ``samples`` (seconds
+    or any unit — values pass through), linear interpolation. Empty input
+    gives NaNs rather than raising: a benchmark that timed nothing should
+    still emit a well-formed report."""
+    import numpy as np
+
+    if len(samples) == 0:
+        return {f"p{p}": float("nan") for p in ps}
+    arr = np.asarray(samples, dtype=np.float64)
+    return {f"p{p}": float(np.percentile(arr, p)) for p in ps}
+
+
+def summarize_latencies(samples, ps=(50, 95, 99)):
+    """``{'count', 'mean', 'p50', 'p95', 'p99'}`` over latency samples.
+
+    Unit-preserving like `percentiles`; empty input yields count 0 and
+    NaN statistics.
+    """
+    import numpy as np
+
+    out = {"count": int(len(samples))}
+    out["mean"] = (
+        float(np.mean(np.asarray(samples, dtype=np.float64)))
+        if len(samples)
+        else float("nan")
+    )
+    out.update(percentiles(samples, ps))
+    return out
+
+
+class Counter:
+    """A monotonically non-decreasing total."""
+
+    kind = "counter"
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n=1):
+        if n < 0:
+            raise ValueError(
+                f"counter {self.name}: negative increment {n!r} "
+                "(counters are monotonic; use a gauge)"
+            )
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+    def snapshot(self):
+        return {"kind": self.kind, "value": self._value}
+
+
+class Gauge:
+    """A point-in-time value; optionally backed by a sampling callback."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+        self._fn = None
+
+    def set(self, value):
+        self._fn = None
+        self._value = value
+
+    def set_fn(self, fn):
+        """Back the gauge by ``fn()`` sampled at read time (queue depths,
+        occupancy: the truth lives in the structure, not the metric)."""
+        self._fn = fn
+
+    @property
+    def value(self):
+        fn = self._fn
+        if fn is not None:
+            try:
+                return fn()
+            except Exception:
+                return float("nan")  # a dead callback must not kill a scrape
+        return self._value
+
+    def snapshot(self):
+        return {"kind": self.kind, "value": self.value}
+
+
+class Histogram:
+    """Explicit-bucket histogram with retained raw samples.
+
+    ``buckets`` are strictly increasing finite upper bounds; an implicit
+    +Inf bucket catches the tail. Bucket membership is ``value <= le``
+    (inclusive upper bound, the Prometheus convention). Raw samples are
+    retained so percentiles are exact, not bucket-interpolated.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", buckets=DEFAULT_LATENCY_BUCKETS):
+        bounds = tuple(float(b) for b in buckets)
+        if not bounds or any(
+            b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])
+        ) or not all(math.isfinite(b) for b in bounds):
+            raise ValueError(
+                f"histogram {name}: buckets must be strictly increasing "
+                f"finite upper bounds, got {buckets!r}"
+            )
+        self.name = name
+        self.help = help
+        self.buckets = bounds
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(bounds) + 1)  # last slot is +Inf
+        self._sum = 0.0
+        self._samples = []
+
+    def observe(self, value):
+        v = float(value)
+        idx = bisect.bisect_left(self.buckets, v)  # v <= le is inclusive
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += v
+            self._samples.append(v)
+
+    @property
+    def count(self):
+        return sum(self._counts)
+
+    @property
+    def sum(self):
+        return self._sum
+
+    @property
+    def samples(self):
+        with self._lock:
+            return list(self._samples)
+
+    def bucket_counts(self):
+        """``[(le, cumulative_count), ...]`` ending with (+Inf, count)."""
+        with self._lock:
+            counts = list(self._counts)
+        out, cum = [], 0
+        for le, c in zip(self.buckets + (math.inf,), counts):
+            cum += c
+            out.append((le, cum))
+        return out
+
+    def percentiles(self, ps=(50, 95, 99)):
+        return percentiles(self.samples, ps)
+
+    def snapshot(self):
+        with self._lock:
+            counts = list(self._counts)
+            total = self._sum
+            samples = list(self._samples)
+        cum, buckets = 0, []
+        for le, c in zip(self.buckets + (math.inf,), counts):
+            cum += c
+            buckets.append([le, cum])
+        snap = {
+            "kind": self.kind,
+            "count": cum,
+            "sum": total,
+            "buckets": buckets,
+        }
+        snap.update(percentiles(samples))
+        return snap
+
+
+class MetricsRegistry:
+    """Named metrics with get-or-create semantics.
+
+    Re-requesting a name returns the SAME metric object (instrumentation
+    sites in different modules share totals by name); re-requesting it as
+    a different kind raises — a name means one thing.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _get_or_create(self, cls, name, help, **kwargs):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help=help, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}"
+                )
+            return m
+
+    def counter(self, name, help=""):
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name, help=""):
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name, help="", buckets=DEFAULT_LATENCY_BUCKETS):
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name):
+        return self._metrics.get(name)
+
+    def names(self):
+        with self._lock:
+            return sorted(self._metrics)
+
+    def snapshot(self):
+        """``{name: metric.snapshot()}`` for every registered metric."""
+        return {name: self._metrics[name].snapshot() for name in self.names()}
+
+    def to_prometheus(self):
+        """Prometheus text exposition (version 0.0.4) of the registry."""
+        lines = []
+        for name in self.names():
+            m = self._metrics[name]
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {m.kind}")
+            if isinstance(m, Histogram):
+                for le, cum in m.bucket_counts():
+                    lines.append(
+                        f'{name}_bucket{{le="{_fmt_le(le)}"}} {cum}'
+                    )
+                lines.append(f"{name}_sum {_fmt(m.sum)}")
+                lines.append(f"{name}_count {m.count}")
+            else:
+                lines.append(f"{name} {_fmt(m.value)}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt_le(le):
+    return "+Inf" if math.isinf(le) else _fmt(le)
+
+
+def _fmt(v):
+    if isinstance(v, bool):
+        return str(int(v))
+    f = float(v)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15 and not math.isnan(f):
+        return str(int(f))
+    return repr(f)
+
+
+# The process-global default registry: train/eval/features/checkpoint
+# instrumentation lands here; the serving engine defaults to a private
+# registry per engine (see ServeEngine(registry=...)).
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry():
+    return _DEFAULT

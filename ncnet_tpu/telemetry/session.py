@@ -1,0 +1,93 @@
+"""Telemetry sessions: one ``--telemetry DIR`` run, one event log.
+
+`start(out_dir)` opens the JSONL event log (``events.jsonl``), enables
+the span tracer with the log as its sink, and remembers which registry
+to snapshot; `stop()` appends a final metric record per registered
+metric, closes the log, and durably writes the Prometheus snapshot
+(``metrics.prom``). The CLIs (`scripts/train.py`, `scripts/serve.py`,
+``bench.py``) wrap their work in exactly this pair, so a single run of
+any of them produces the one schema `scripts/telemetry_report.py`
+renders.
+
+One session per process: spans are global (the tracer is a module
+singleton), so a second concurrent session would interleave sinks.
+"""
+
+import os
+import threading
+import time
+
+from ncnet_tpu.telemetry import trace
+from ncnet_tpu.telemetry.export import (
+    EVENTS_NAME,
+    PROM_NAME,
+    SCHEMA_VERSION,
+    JsonlWriter,
+    metric_events,
+    write_prometheus,
+)
+from ncnet_tpu.telemetry.registry import default_registry
+
+_lock = threading.Lock()
+_active = None
+
+
+class TelemetrySession:
+    def __init__(self, out_dir, registry=None, label=None):
+        self.out_dir = out_dir
+        self.registry = registry if registry is not None else default_registry()
+        os.makedirs(out_dir, exist_ok=True)
+        self.events_path = os.path.join(out_dir, EVENTS_NAME)
+        self.prom_path = os.path.join(out_dir, PROM_NAME)
+        self.writer = JsonlWriter(self.events_path)
+        self.writer.write({
+            "type": "meta",
+            "schema": SCHEMA_VERSION,
+            "ts": time.time(),
+            "label": label,
+            "pid": os.getpid(),
+        })
+        trace.enable(sink=self.writer.write)
+        self._stopped = False
+
+    def flush_metrics(self):
+        """Append one metric record per registered metric (also runs at
+        `stop`; call mid-run for coarse time series)."""
+        for event in metric_events(self.registry):
+            self.writer.write(event)
+        self.writer.flush()
+
+    def stop(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        trace.disable()
+        self.flush_metrics()
+        self.writer.close()
+        write_prometheus(self.prom_path, self.registry)
+
+
+def start(out_dir, registry=None, label=None):
+    """Begin the process-wide telemetry session writing under
+    ``out_dir``; returns the `TelemetrySession`."""
+    global _active
+    with _lock:
+        if _active is not None:
+            raise RuntimeError(
+                f"telemetry session already active ({_active.out_dir})"
+            )
+        _active = TelemetrySession(out_dir, registry=registry, label=label)
+        return _active
+
+
+def stop():
+    """End the active session (no-op without one)."""
+    global _active
+    with _lock:
+        session, _active = _active, None
+    if session is not None:
+        session.stop()
+
+
+def active():
+    return _active

@@ -1,0 +1,150 @@
+"""Span tracer: nested, thread-safe timing regions on monotonic clocks.
+
+Usage at an instrumentation site::
+
+    from ncnet_tpu.telemetry import trace
+    with trace.span("step/device_compute"):
+        state, loss = train_step(state, dbatch)
+
+Contract:
+
+  * **Disabled is free.** When tracing is off, ``span()`` returns ONE
+    cached no-op singleton — no allocation, no clock read; the call pays
+    a single attribute lookup on the tracer (the same contract as
+    `resilience.faultinject.fire` and `analysis.sanitizer`). Hot paths
+    (the serving prep/dispatch/readout loops, the per-step training
+    loop) keep their spans unconditionally.
+  * **Monotonic clocks.** Durations come from ``time.perf_counter``
+    deltas, never wall clock (NTP steps make ``time.time`` run
+    backwards; the `wall-clock-timing` nclint rule enforces this
+    repo-wide). The wall-clock ``ts`` field on each event is a
+    TIMESTAMP — an epoch anchor captured once at enable time plus a
+    monotonic offset — not a duration operand.
+  * **Nestable + thread-safe.** Each thread keeps its own span stack;
+    an event's ``path`` joins the enclosing names with ``>``
+    ("serve/dispatch>serve/device"), which is what the report's span
+    tree and self-time accounting key on. The separator is NOT ``/``
+    because span names use ``/`` for their surface prefix
+    ("step/loss_sync") — nesting must stay unambiguous.
+
+Events are dicts ``{type, name, path, ts, dur_s, thread, ok}`` delivered
+to the enabled sink (a `telemetry.export.JsonlWriter.write`, usually) or
+buffered in memory for tests.
+"""
+
+import threading
+import time
+
+
+class _NullSpan:
+    """The disabled-mode span: one shared instance, no state, no clock."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "_t0")
+
+    def __init__(self, tracer, name):
+        self._tracer = tracer
+        self.name = name
+
+    def __enter__(self):
+        stack = self._tracer._stack()
+        stack.append(self.name)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter()
+        tracer = self._tracer
+        stack = tracer._stack()
+        path = ">".join(stack)
+        if stack:
+            stack.pop()
+        tracer._emit({
+            "type": "span",
+            "name": self.name,
+            "path": path,
+            "ts": tracer._wall0 + (self._t0 - tracer._perf0),
+            "dur_s": t1 - self._t0,
+            "thread": threading.get_ident(),
+            "ok": exc_type is None,
+        })
+        return False
+
+
+class Tracer:
+    def __init__(self):
+        self._enabled = False
+        self._sink = None
+        self._lock = threading.Lock()
+        self._buffer = []
+        self._local = threading.local()
+        self._wall0 = time.time()  # epoch anchor for ts, not a duration
+        self._perf0 = time.perf_counter()
+
+    def span(self, name):
+        if not self._enabled:
+            return _NULL_SPAN
+        return _Span(self, name)
+
+    def is_enabled(self):
+        return self._enabled
+
+    def enable(self, sink=None):
+        """Turn tracing on. ``sink(event)`` receives each completed span;
+        without one, events buffer in memory (drain with `drain`)."""
+        with self._lock:
+            self._sink = sink
+            self._wall0 = time.time()  # re-anchor the epoch mapping
+            self._perf0 = time.perf_counter()
+            self._enabled = True
+
+    def disable(self):
+        with self._lock:
+            self._enabled = False
+            self._sink = None
+
+    def drain(self):
+        """Return and clear the in-memory event buffer."""
+        with self._lock:
+            events, self._buffer = self._buffer, []
+        return events
+
+    # ------------------------------------------------------------------
+
+    def _stack(self):
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _emit(self, event):
+        sink = self._sink
+        if sink is not None:
+            sink(event)
+        else:
+            with self._lock:
+                self._buffer.append(event)
+
+
+_TRACER = Tracer()
+
+# Module-level API: `trace.span(...)` at every instrumentation site.
+# Bound once so the disabled hot path is one attribute load + the
+# tracer's single `_enabled` check.
+span = _TRACER.span
+is_enabled = _TRACER.is_enabled
+enable = _TRACER.enable
+disable = _TRACER.disable
+drain = _TRACER.drain

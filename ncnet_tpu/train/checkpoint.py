@@ -31,6 +31,15 @@ from flax import serialization
 
 from ncnet_tpu.models.immatchnet import ImMatchNetConfig
 from ncnet_tpu.resilience import durable
+from ncnet_tpu.telemetry import trace
+from ncnet_tpu.telemetry.registry import default_registry
+
+
+def _ckpt_bytes_counter():
+    return default_registry().counter(
+        "checkpoint_bytes_written_total",
+        "serialized checkpoint bytes durably committed",
+    )
 
 
 @dataclasses.dataclass
@@ -131,16 +140,19 @@ def save_checkpoint(path, data: CheckpointData, is_best=False, keep=3):
     for `load_latest_valid` to fall back on.
     """
     path = os.path.abspath(path)
-    blob = serialize_checkpoint(data)
-    durable.durable_write_bytes(path, blob)
-    durable.retain(path, data.step, keep=keep)
-    if is_best:
-        # ``best_`` is a hardlinked pointer to the just-committed main file
-        # (O(1), no re-serialization of the tree); the link target was
-        # written durably above, so readers still see old-or-new, never torn
-        base = os.path.basename(path)
-        best = os.path.join(os.path.dirname(path), "best_" + base)
-        durable.link_or_copy(path, best)
+    with trace.span("checkpoint/save"):
+        blob = serialize_checkpoint(data)
+        durable.durable_write_bytes(path, blob)
+        durable.retain(path, data.step, keep=keep)
+        _ckpt_bytes_counter().inc(len(blob))
+        if is_best:
+            # ``best_`` is a hardlinked pointer to the just-committed main
+            # file (O(1), no re-serialization of the tree); the link target
+            # was written durably above, so readers still see old-or-new,
+            # never torn
+            base = os.path.basename(path)
+            best = os.path.join(os.path.dirname(path), "best_" + base)
+            durable.link_or_copy(path, best)
 
 
 def load_checkpoint(path, opt_state_target=None) -> CheckpointData:
@@ -148,26 +160,29 @@ def load_checkpoint(path, opt_state_target=None) -> CheckpointData:
     ``resilience.durable.IntegrityError`` on mismatch). To restore optimizer
     state into the right pytree structure, pass a freshly-initialized
     ``opt_state_target``."""
-    payload = serialization.msgpack_restore(
-        durable.read_verified_bytes(path)
-    )
-    config = ImMatchNetConfig.from_dict(payload["config"])
-    opt_state = payload.get("opt_state") or None
-    if opt_state is not None and opt_state_target is not None:
-        opt_state = serialization.from_state_dict(opt_state_target, opt_state)
-    return CheckpointData(
-        config=config,
-        params=_relistify(payload["params"]),
-        opt_state=opt_state,
-        step=int(payload.get("step", 0)),
-        epoch=int(payload.get("epoch", 0)),
-        train_loss=payload.get("train_loss"),
-        val_loss=payload.get("val_loss"),
-        best_val_loss=payload.get("best_val_loss"),
-        train_fe=bool(payload.get("train_fe", False)),
-        fe_finetune_blocks=int(payload.get("fe_finetune_blocks", 0)),
-        cursor=_cursor_from_payload(payload),
-    )
+    with trace.span("checkpoint/restore"):
+        payload = serialization.msgpack_restore(
+            durable.read_verified_bytes(path)
+        )
+        config = ImMatchNetConfig.from_dict(payload["config"])
+        opt_state = payload.get("opt_state") or None
+        if opt_state is not None and opt_state_target is not None:
+            opt_state = serialization.from_state_dict(
+                opt_state_target, opt_state
+            )
+        return CheckpointData(
+            config=config,
+            params=_relistify(payload["params"]),
+            opt_state=opt_state,
+            step=int(payload.get("step", 0)),
+            epoch=int(payload.get("epoch", 0)),
+            train_loss=payload.get("train_loss"),
+            val_loss=payload.get("val_loss"),
+            best_val_loss=payload.get("best_val_loss"),
+            train_fe=bool(payload.get("train_fe", False)),
+            fe_finetune_blocks=int(payload.get("fe_finetune_blocks", 0)),
+            cursor=_cursor_from_payload(payload),
+        )
 
 
 def load_latest_valid(path, opt_state_target=None):
@@ -247,11 +262,26 @@ def save_checkpoint_sharded(
     re-serialization). Returns the committed ``step_<N>/`` directory."""
     from ncnet_tpu.resilience import distributed
 
-    leaves, meta_blob = _sharded_parts(data)
-    return distributed.save_sharded(
-        dir_path, int(data.step), leaves, meta_blob,
-        keep=keep, is_best=is_best, **save_kwargs,
-    )
+    with trace.span("checkpoint/save"):
+        leaves, meta_blob = _sharded_parts(data)
+        out = distributed.save_sharded(
+            dir_path, int(data.step), leaves, meta_blob,
+            keep=keep, is_best=is_best, **save_kwargs,
+        )
+        # this process's contribution: the replicated meta plus its own
+        # unique shard chunks (numpy leaves count whole; jax.Arrays count
+        # each addressable shard once — replica copies excluded)
+        nbytes = len(meta_blob)
+        for _, leaf in leaves:
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards is not None:
+                nbytes += sum(
+                    s.data.nbytes for s in shards if s.replica_id == 0
+                )
+            else:
+                nbytes += np.asarray(leaf).nbytes
+        _ckpt_bytes_counter().inc(nbytes)
+        return out
 
 
 def _checkpoint_from_reader(reader, opt_state_target=None, shardings=None):
@@ -304,11 +334,12 @@ def load_checkpoint_sharded(step_dir, opt_state_target=None, shardings=None):
     sharding come back as host numpy, matching `load_checkpoint`."""
     from ncnet_tpu.resilience import distributed
 
-    return _checkpoint_from_reader(
-        distributed.SaveReader(step_dir),
-        opt_state_target=opt_state_target,
-        shardings=shardings,
-    )
+    with trace.span("checkpoint/restore"):
+        return _checkpoint_from_reader(
+            distributed.SaveReader(step_dir),
+            opt_state_target=opt_state_target,
+            shardings=shardings,
+        )
 
 
 def load_latest_valid_sharded(dir_path, opt_state_target=None, shardings=None):

@@ -27,8 +27,15 @@ import jax
 import jax.numpy as jnp
 
 from ncnet_tpu.analysis import sanitizer
+from ncnet_tpu.ops.accounting import (
+    V5E_BF16_PEAK_FLOPS,
+    train_step_flops_for_batch,
+)
 from ncnet_tpu.parallel.mesh import make_hybrid_mesh, replicate, shard_batch
 from ncnet_tpu.resilience import faultinject
+from ncnet_tpu.telemetry import trace
+from ncnet_tpu.telemetry.profiler import ProfileWindow
+from ncnet_tpu.telemetry.registry import default_registry
 from ncnet_tpu.train.checkpoint import (
     CheckpointData,
     save_checkpoint,
@@ -317,51 +324,93 @@ def _train_impl(
             keep=keep_checkpoints,
         )
 
-    profiling = False
+    # Telemetry (ncnet_tpu.telemetry): per-step spans split host data-wait
+    # vs device compute dispatch vs the D2H loss sync; gauges carry the
+    # log-interval step time and analytic MFU — the SAME FLOP count
+    # bench.py reports (ops.accounting), so a --telemetry training run and
+    # a bench run disagree only by measurement, never by accounting.
+    metrics = default_registry()
+    m_steps = metrics.counter("train_steps_total", "optimizer steps taken")
+    m_step_s = metrics.histogram(
+        "train_step_seconds", "wall seconds per training step"
+    )
+    m_step_ms = metrics.gauge(
+        "train_step_ms", "mean ms/step over the last log interval"
+    )
+    m_mfu = metrics.gauge(
+        "train_mfu",
+        "analytic model FLOP utilization vs the v5e bf16 peak",
+    )
+    window = ProfileWindow(profile_dir, profile_steps)
     preempted = False
+    done = object()  # prefetch-exhausted sentinel
     for epoch in range(start_epoch, num_epochs):
-        t0 = time.time()
+        t0 = time.perf_counter()
         t_last = t0
+        t_step = t0
         skip = start_batch if epoch == start_epoch else 0
         # a resumed epoch re-seeds its already-computed step losses so the
         # epoch mean is over ALL its steps, not just the replayed tail
         losses = _LossLog(start_epoch_losses if skip else None)
         batches = _epoch_iter(train_loader, epoch, skip=skip)
-        for i, dbatch in enumerate(
-            _prefetch_device_batches(mesh, batches), start=skip
-        ):
+        prefetch = _prefetch_device_batches(mesh, batches)
+
+        def sync_losses():
+            # D2H sync so the device finishes the profiled steps before a
+            # trace closes (block_until_ready does not block on the
+            # tunneled platform — see bench.py)
+            if len(losses):
+                losses.host()
+
+        i = skip - 1
+        while True:
+            # the data-wait span is the host blocked on the loader +
+            # H2D prefetch — when it dominates, the input pipeline is
+            # the bottleneck, not the device
+            with trace.span("step/data_wait"):
+                dbatch = next(prefetch, done)
+            if dbatch is done:
+                break
+            i += 1
             if profile_dir and epoch == start_epoch:
-                if i == profile_steps[0]:
-                    jax.profiler.start_trace(profile_dir)
-                    profiling = True
-                elif i == profile_steps[1] and profiling:
-                    # D2H sync so the device finishes the profiled steps
-                    # before the trace closes (block_until_ready does not
-                    # block on the tunneled platform — see bench.py)
-                    if len(losses):
-                        losses.host()
-                    jax.profiler.stop_trace()
-                    profiling = False
-                    print(f"profile trace written to {profile_dir}", flush=True)
-            state, loss = train_step(state, dbatch)
+                window.on_step(i, sync=sync_losses)
+            with trace.span("step/device_compute"):
+                # asynchronous dispatch: host-side cost of launching the
+                # step; device execution time lands in the NEXT sync
+                # (step/loss_sync or the epoch-end mean)
+                state, loss = train_step(state, dbatch)
             losses.append(loss)
+            m_steps.inc()
+            now_step = time.perf_counter()
+            m_step_s.observe(now_step - t_step)
+            t_step = now_step
             faultinject.fire("step.boundary")
             if sanitizer.is_enabled():
                 # sanitized runs are diagnostic: pay a per-step D2H sync so
                 # a non-finite loss stops IMMEDIATELY with the per-stage
                 # report + first non-finite stage, instead of averaging
                 # NaN into the epoch
+                with trace.span("step/loss_sync"):
+                    loss_last = losses.host()[-1]
                 sanitizer.check_finite_or_report(
-                    losses.host()[-1],
+                    loss_last,
                     context=f"epoch {epoch + 1} step {i + 1}",
                 )
             if (i + 1) % log_every == 0:
                 # host() syncs on the just-appended loss, keeping the step
                 # timing honest without a second transfer of that loss
-                loss_host = losses.host()[-1]
-                now = time.time()
+                with trace.span("step/loss_sync"):
+                    loss_host = losses.host()[-1]
+                now = time.perf_counter()
                 ms = (now - t_last) / log_every * 1e3
                 t_last = now
+                m_step_ms.set(ms)
+                m_mfu.set(
+                    train_step_flops_for_batch(
+                        config, dbatch, from_features=from_features
+                    )
+                    / (max(ms, 1e-6) / 1e3 * V5E_BF16_PEAK_FLOPS)
+                )
                 print(
                     f"epoch {epoch + 1} [{i + 1}/{len(train_loader)}] "
                     f"loss {loss_host:.6f} ({ms:.0f} ms/step)",
@@ -382,9 +431,7 @@ def _train_impl(
                 )
                 preempted = True
                 break
-        if profiling:  # epoch shorter than the profile window
-            jax.profiler.stop_trace()
-            profiling = False
+        window.close(sync=sync_losses)  # epoch shorter than the window
         if preempted:
             break
         train_loss = float(np.mean(losses.host())) if len(losses) else 0.0
@@ -407,7 +454,7 @@ def _train_impl(
         is_best = val_loss < best_val
         best_val = min(best_val, val_loss) if not np.isnan(val_loss) else best_val
 
-        epoch_s = time.time() - t0
+        epoch_s = time.perf_counter() - t0
         print(
             f"epoch {epoch + 1}/{num_epochs}: train {train_loss:.6f} "
             f"val {val_loss:.6f} ({epoch_s:.1f}s)"

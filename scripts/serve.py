@@ -93,6 +93,12 @@ def parse_args(argv=None):
                         "the batched engine")
     p.add_argument("--report", type=str, default=None,
                    help="write the JSON report here too")
+    p.add_argument("--telemetry", type=str, default=None, metavar="DIR",
+                   help="write a telemetry run under DIR "
+                        "(ncnet_tpu.telemetry): the engine's metrics and "
+                        "per-stage spans land in a durable events.jsonl "
+                        "plus a metrics.prom snapshot at exit; render "
+                        "with scripts/telemetry_report.py DIR")
     return p.parse_args(argv)
 
 
@@ -139,6 +145,21 @@ def image_shape(path):
 def main(argv=None):
     args = parse_args(argv)
 
+    from ncnet_tpu import telemetry
+
+    if args.telemetry:
+        # one process-wide registry: the engine registers its metrics in
+        # it, the session snapshots it at stop()
+        telemetry.start(args.telemetry, label="serve")
+        print(f"telemetry: {args.telemetry} "
+              "(render with scripts/telemetry_report.py)", flush=True)
+    try:
+        return _run(args, telemetry)
+    finally:
+        telemetry.stop()  # no-op without --telemetry
+
+
+def _run(args, telemetry):
     from ncnet_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache(args.compile_cache)
@@ -237,26 +258,35 @@ def main(argv=None):
 
     if args.sequential:
         # the per-pair baseline: one jitted wrapper (per-shape cache),
-        # host prep inline on this thread, synchronous readout
+        # host prep inline on this thread, synchronous readout. Latency
+        # accounting runs through the same telemetry histogram as the
+        # batched engine, so both modes report identical keys from one
+        # implementation (telemetry.registry.percentiles).
+        from ncnet_tpu.telemetry import trace
+        from ncnet_tpu.telemetry.registry import percentiles
+
+        m_lat = telemetry.default_registry().histogram(
+            "serve_request_latency_seconds",
+            "sequential-baseline per-pair latency",
+            buckets=telemetry.DEFAULT_LATENCY_BUCKETS,
+        )
         jitted = jax.jit(apply_fn)
-        latencies = []
         t0 = time.perf_counter()
         for pair in requests:
             t_req = time.perf_counter()
-            _, payload = prep(pair)
-            out = jitted(
-                params, {k: v[None] for k, v in payload.items()}
-            )
-            jax.tree_util.tree_map(np.asarray, out)
-            latencies.append(time.perf_counter() - t_req)
+            with trace.span("serve/prep"):
+                _, payload = prep(pair)
+            with trace.span("serve/dispatch"):
+                out = jitted(
+                    params, {k: v[None] for k, v in payload.items()}
+                )
+            with trace.span("serve/readout"):
+                jax.tree_util.tree_map(np.asarray, out)
+            m_lat.observe(time.perf_counter() - t_req)
         wall = time.perf_counter() - t0
-        report.update(
-            wall_s=wall,
-            pairs_per_s=len(requests) / wall,
-            latency_p50_ms=float(np.percentile(latencies, 50)) * 1e3,
-            latency_p95_ms=float(np.percentile(latencies, 95)) * 1e3,
-            latency_p99_ms=float(np.percentile(latencies, 99)) * 1e3,
-        )
+        report.update(wall_s=wall, pairs_per_s=len(requests) / wall)
+        for pname, v in percentiles(m_lat.samples).items():
+            report[f"latency_{pname}_ms"] = float(v) * 1e3
     else:
         with ServeEngine(
             apply_fn,
@@ -267,6 +297,8 @@ def main(argv=None):
             host_workers=args.host_workers,
             prep_fn=prep,
             prep_retries=args.prep_retries,
+            registry=(telemetry.default_registry() if args.telemetry
+                      else None),
         ) as engine:
             # warmup: one prep per distinct bucket discovers the payload
             # spec (for images this only needs the file header; the

@@ -1,0 +1,205 @@
+"""Render a telemetry JSONL event log into per-surface summary tables.
+
+Consumes the ``events.jsonl`` a ``--telemetry DIR`` run writes
+(``scripts/train.py``, ``scripts/serve.py``, ``bench.py`` — one schema,
+`ncnet_tpu.telemetry.export`) and prints:
+
+  * a **span table** per surface (the first path segment: ``step``,
+    ``serve``, ``eval``, ``checkpoint``, ``features``): count, total
+    seconds, SELF seconds (total minus the time attributed to child
+    spans — the span tree's exclusive time), and p50/p95/p99 of the
+    span duration;
+  * a **metrics table**: final counter/gauge values and histogram
+    count/sum/percentiles.
+
+Pure host-side rendering: imports `ncnet_tpu.telemetry` (stdlib + numpy)
+but never jax, so it runs anywhere the log file does — a laptop reading
+a log scp'd off a pod.
+
+Usage:
+  python scripts/telemetry_report.py RUN_DIR_or_events.jsonl [--json]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ncnet_tpu.telemetry.export import EVENTS_NAME, read_events  # noqa: E402
+from ncnet_tpu.telemetry.registry import percentiles  # noqa: E402
+
+
+def aggregate_spans(events):
+    """Span aggregation by path: ``{path: {count, total_s, self_s,
+    p50/p95/p99, name}}``.
+
+    Self time = the path's total minus its DIRECT children's totals
+    (exclusive time in the span tree). Paths are the nesting record —
+    "a>b" is a "b" span that ran inside an "a" span (``>`` is the
+    nesting separator; ``/`` belongs to span NAMES like
+    "step/loss_sync") — so parentage is pure string structure;
+    aggregation is across threads and repeats.
+    """
+    durs = {}
+    for e in events:
+        if e.get("type") != "span":
+            continue
+        durs.setdefault(e["path"], []).append(float(e["dur_s"]))
+    child_total = {}
+    for path, samples in durs.items():
+        parent = path.rsplit(">", 1)[0] if ">" in path else None
+        if parent is not None:
+            child_total[parent] = child_total.get(parent, 0.0) + sum(samples)
+    out = {}
+    for path, samples in sorted(durs.items()):
+        total = sum(samples)
+        row = {
+            "name": path.rsplit(">", 1)[-1],
+            "count": len(samples),
+            "total_s": total,
+            "self_s": total - child_total.get(path, 0.0),
+        }
+        row.update(percentiles(samples))
+        out[path] = row
+    return out
+
+
+def final_metrics(events):
+    """Last metric record per name (the stop()-time snapshot wins)."""
+    out = {}
+    for e in events:
+        if e.get("type") == "metric":
+            out[e["name"]] = e
+    return out
+
+
+def by_surface(span_rows):
+    """Group by the ROOT span's surface prefix ("serve/dispatch>x" and
+    "serve/prep" both land under "serve")."""
+    surfaces = {}
+    for path, row in span_rows.items():
+        root = path.split(">", 1)[0]
+        surfaces.setdefault(root.split("/", 1)[0], {})[path] = row
+    return surfaces
+
+
+def _fmt_s(v):
+    if v != v:  # NaN
+        return "nan"
+    if abs(v) >= 1.0:
+        return f"{v:.3f}s"
+    return f"{v * 1e3:.2f}ms"
+
+
+def _fmt_num(v):
+    if v != v:  # NaN
+        return "nan"
+    return f"{v:g}"
+
+
+def _table(rows, headers):
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [fmt.format(*headers), fmt.format(*("-" * w for w in widths))]
+    lines += [fmt.format(*r) for r in rows]
+    return "\n".join(lines)
+
+
+def render(events):
+    """The human-readable report for a parsed event list."""
+    spans = aggregate_spans(events)
+    metrics = final_metrics(events)
+    blocks = []
+    for surface, rows in sorted(by_surface(spans).items()):
+        table = [
+            [
+                path,
+                str(r["count"]),
+                _fmt_s(r["total_s"]),
+                _fmt_s(r["self_s"]),
+                _fmt_s(r["p50"]),
+                _fmt_s(r["p95"]),
+                _fmt_s(r["p99"]),
+            ]
+            for path, r in rows.items()
+        ]
+        blocks.append(
+            f"== {surface} spans ==\n"
+            + _table(
+                table,
+                ["path", "count", "total", "self", "p50", "p95", "p99"],
+            )
+        )
+    if metrics:
+        table = []
+        for name in sorted(metrics):
+            m = metrics[name]
+            if m.get("kind") == "histogram":
+                # durations render as s/ms; other histograms (batch
+                # sizes, byte counts) as plain numbers
+                fmt = _fmt_s if name.endswith("_seconds") else _fmt_num
+                value = f"count={m['count']} sum={fmt(m['sum'])}"
+                pcts = " ".join(
+                    f"{p}={fmt(m[p])}"
+                    for p in ("p50", "p95", "p99")
+                    if p in m
+                )
+                table.append([name, m["kind"], value, pcts])
+            else:
+                table.append([name, m["kind"], str(m.get("value")), ""])
+        blocks.append(
+            "== metrics ==\n"
+            + _table(table, ["name", "kind", "value", "percentiles"])
+        )
+    if not blocks:
+        blocks.append("(no span or metric events in the log)")
+    return "\n\n".join(blocks)
+
+
+def report(path):
+    """Machine-readable report dict for a log path (file or run dir)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, EVENTS_NAME)
+    events = read_events(path)
+    return {
+        "events": len(events),
+        "spans": aggregate_spans(events),
+        "metrics": final_metrics(events),
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="render a telemetry events.jsonl into summary tables"
+    )
+    p.add_argument("path", help="run dir (containing events.jsonl) or a "
+                                "JSONL file")
+    p.add_argument("--json", action="store_true",
+                   help="emit the aggregation as JSON instead of tables")
+    args = p.parse_args(argv)
+
+    path = args.path
+    if os.path.isdir(path):
+        path = os.path.join(path, EVENTS_NAME)
+    events = read_events(path)
+    if args.json:
+        print(json.dumps(
+            {
+                "events": len(events),
+                "spans": aggregate_spans(events),
+                "metrics": final_metrics(events),
+            },
+            indent=2, sort_keys=True, default=str,
+        ))
+    else:
+        print(render(events))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

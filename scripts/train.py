@@ -140,6 +140,18 @@ def main():
     p.add_argument("--profile_dir", type=str, default="",
                    help="capture a jax.profiler trace of a few early steps "
                         "into this directory")
+    p.add_argument("--profile-steps", type=str, default="3:8",
+                   dest="profile_steps", metavar="A:B",
+                   help="with --profile_dir: the half-open step window "
+                        "[A:B) of the first epoch to trace (default 3:8 — "
+                        "past the compile step, short enough to keep the "
+                        "trace small)")
+    p.add_argument("--telemetry", type=str, default="", metavar="DIR",
+                   help="write a telemetry run under DIR "
+                        "(ncnet_tpu.telemetry): a durable events.jsonl "
+                        "span/metric log plus a metrics.prom Prometheus "
+                        "snapshot at exit; render with "
+                        "scripts/telemetry_report.py DIR")
     p.add_argument("--multihost", action="store_true",
                    help="join a multi-host JAX runtime (TPU pod slices: "
                         "auto-detected); shards the data loaders per host")
@@ -200,6 +212,22 @@ def main():
                         "checkpoint resumes keep their recorded value "
                         "unless --chunk_remat/--no-chunk_remat is given")
     args = p.parse_args()
+
+    from ncnet_tpu.telemetry.profiler import parse_steps
+
+    try:
+        profile_steps = parse_steps(args.profile_steps)
+    except ValueError as e:
+        p.error(str(e))
+
+    if args.telemetry:
+        # started before any instrumented work so compile-time spans and
+        # the feature-cache populate pass land in the log too
+        from ncnet_tpu import telemetry
+
+        telemetry.start(args.telemetry, label="train")
+        print(f"telemetry: {args.telemetry} "
+              "(render with scripts/telemetry_report.py)", flush=True)
 
     from ncnet_tpu.utils.compile_cache import enable_compile_cache
 
@@ -492,35 +520,44 @@ def main():
     # preemption notice) or Ctrl-C checkpoints once at the next step
     # boundary and exits cleanly, with the worker pools shut down on every
     # path (train() also closes the loaders from its own finally)
-    with PreemptionGuard() as guard, make_loader(
-        "train", True
-    ) as train_loader, make_loader("val", False) as val_loader:
-        _, history = train(
-            config,
-            params,
-            train_loader,
-            val_loader,
-            num_epochs=args.num_epochs,
-            learning_rate=args.lr,
-            train_fe=args.train_fe,
-            fe_finetune_blocks=args.fe_finetune_params,
-            checkpoint_dir=args.result_model_dir,
-            checkpoint_name=args.result_model_fn,
-            start_epoch=start_epoch,
-            start_step=start_step,
-            start_batch=start_batch,
-            start_epoch_losses=start_epoch_losses,
-            opt_state=opt_state,
-            initial_best_val=best_val,
-            initial_train_hist=train_hist,
-            initial_val_hist=val_hist,
-            profile_dir=args.profile_dir or None,
-            save_every_steps=args.save_every_steps,
-            keep_checkpoints=args.keep_checkpoints,
-            preemption=guard,
-            from_features=from_features,
-            distributed_checkpoints=args.distributed_checkpoints,
-        )
+    try:
+        with PreemptionGuard() as guard, make_loader(
+            "train", True
+        ) as train_loader, make_loader("val", False) as val_loader:
+            _, history = train(
+                config,
+                params,
+                train_loader,
+                val_loader,
+                num_epochs=args.num_epochs,
+                learning_rate=args.lr,
+                train_fe=args.train_fe,
+                fe_finetune_blocks=args.fe_finetune_params,
+                checkpoint_dir=args.result_model_dir,
+                checkpoint_name=args.result_model_fn,
+                start_epoch=start_epoch,
+                start_step=start_step,
+                start_batch=start_batch,
+                start_epoch_losses=start_epoch_losses,
+                opt_state=opt_state,
+                initial_best_val=best_val,
+                initial_train_hist=train_hist,
+                initial_val_hist=val_hist,
+                profile_dir=args.profile_dir or None,
+                profile_steps=profile_steps,
+                save_every_steps=args.save_every_steps,
+                keep_checkpoints=args.keep_checkpoints,
+                preemption=guard,
+                from_features=from_features,
+                distributed_checkpoints=args.distributed_checkpoints,
+            )
+    finally:
+        # flushes the event log + .prom snapshot on EVERY exit path, the
+        # same posture as the loaders' context managers (no-op without
+        # --telemetry)
+        from ncnet_tpu import telemetry
+
+        telemetry.stop()
     if history.get("preempted"):
         print("exiting after preemption checkpoint (resume with "
               f"--checkpoint {os.path.join(args.result_model_dir, args.result_model_fn)})",

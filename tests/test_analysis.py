@@ -552,6 +552,80 @@ def test_recompile_hazard_exempts_tests():
     )
 
 
+# --- wall-clock-timing ------------------------------------------------------
+
+
+BAD_WALL_DIRECT = """
+import time
+
+def measure(fn):
+    t0 = time.time()
+    fn()
+    return time.time() - t0
+"""
+
+BAD_WALL_ALIASED = """
+import time as clock
+
+def measure(fn):
+    start = clock.time()
+    fn()
+    dur = clock.time() - start
+    return dur
+"""
+
+GOOD_MONOTONIC_TIMING = """
+import time
+
+def measure(fn):
+    t0 = time.perf_counter()
+    fn()
+    dt = time.perf_counter() - t0
+    stamp = time.time()  # a TIMESTAMP field, never subtracted
+    return dt, stamp
+"""
+
+GOOD_WALL_AT_MODULE_SCOPE = """
+import time
+
+EPOCH_ANCHOR = time.time()
+OFFSET = 1.5 - 0.5  # an unrelated subtraction stays silent
+"""
+
+
+def test_wall_clock_subtraction_flagged_both_operands():
+    # t0-on-the-right (the common shape) and the call on either side
+    assert rule_ids(BAD_WALL_DIRECT) == ["wall-clock-timing"]
+    flipped = BAD_WALL_DIRECT.replace(
+        "return time.time() - t0", "return t0 - time.time()"
+    )
+    assert rule_ids(flipped) == ["wall-clock-timing"]
+
+
+def test_wall_clock_alias_and_name_expansion():
+    # `import time as clock` resolves through ctx.canonical; `start` is
+    # expanded one level to its `clock.time()` assignment
+    assert rule_ids(BAD_WALL_ALIASED) == ["wall-clock-timing"]
+
+
+def test_monotonic_timing_and_timestamps_clean():
+    assert rule_ids(GOOD_MONOTONIC_TIMING) == []
+    assert rule_ids(GOOD_WALL_AT_MODULE_SCOPE) == []
+
+
+def test_wall_clock_rule_exempts_tests():
+    assert rule_ids(BAD_WALL_DIRECT, path="tests/test_x.py") == []
+
+
+def test_wall_clock_suppression_with_reason():
+    src = BAD_WALL_DIRECT.replace(
+        "return time.time() - t0",
+        "return time.time() - t0  "
+        "# nclint: disable=wall-clock-timing -- wall-time budget on purpose",
+    )
+    assert rule_ids(src) == []
+
+
 # --- suppressions -----------------------------------------------------------
 
 

@@ -481,6 +481,7 @@ def test_serve_cli_smoke(tmp_path):
         ).save(imgdir / f"im{i}.png")
 
     report_path = tmp_path / "report.json"
+    telem_dir = tmp_path / "telem"
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     r = subprocess.run(
         [
@@ -492,6 +493,7 @@ def test_serve_cli_smoke(tmp_path):
             "--max-batch", "2",
             "--max-wait-ms", "20",
             "--report", str(report_path),
+            "--telemetry", str(telem_dir),
         ],
         capture_output=True, text=True, env=env, cwd=str(REPO), timeout=300,
     )
@@ -503,3 +505,27 @@ def test_serve_cli_smoke(tmp_path):
     assert report["buckets"] == 1
     assert report["pairs_per_s"] > 0
     assert report["latency_p95_ms"] >= report["latency_p50_ms"]
+
+    # the same run produced a renderable telemetry log (acceptance
+    # criterion: one --telemetry flag -> events.jsonl + metrics.prom
+    # that telemetry_report.py understands); report rendering runs
+    # in-process — it is jax-free by contract
+    from ncnet_tpu.telemetry.export import read_events
+    from scripts.telemetry_report import render, report as telem_report
+
+    assert (telem_dir / "events.jsonl").exists()
+    prom = (telem_dir / "metrics.prom").read_text()
+    assert "# TYPE serve_requests_completed_total counter" in prom
+    assert "serve_requests_completed_total 2" in prom
+    assert "# TYPE serve_request_latency_seconds histogram" in prom
+
+    events = read_events(str(telem_dir / "events.jsonl"))
+    kinds = {e["type"] for e in events}
+    assert {"meta", "span", "metric"} <= kinds
+    agg = telem_report(str(telem_dir))
+    # the engine's three pipeline stages all produced spans
+    roots = {p.split(">", 1)[0] for p in agg["spans"]}
+    assert {"serve/prep", "serve/dispatch", "serve/readout"} <= roots
+    assert agg["metrics"]["serve_batches_total"]["value"] >= 1
+    text = render(events)
+    assert "== serve spans ==" in text and "== metrics ==" in text

@@ -1,0 +1,476 @@
+"""ncnet_tpu.telemetry: registry semantics, Prometheus golden text, the
+disabled-tracer no-op contract, durable JSONL export under injected
+faults, the report's span-tree self-time math, serve-engine stats
+parity, and one in-process tiny training run producing the full
+--telemetry artifact set."""
+
+import json
+import math
+import os
+import sys
+import tracemalloc
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.resilience import faultinject
+from ncnet_tpu.resilience.faultinject import InjectedFault
+from ncnet_tpu.telemetry import session as telemetry_session
+from ncnet_tpu.telemetry import trace
+from ncnet_tpu.telemetry.export import (
+    EVENTS_NAME,
+    PROM_NAME,
+    JsonlWriter,
+    metric_events,
+    read_events,
+    write_prometheus,
+)
+from ncnet_tpu.telemetry.profiler import ProfileWindow, parse_steps
+from ncnet_tpu.telemetry.registry import (
+    DEFAULT_LATENCY_BUCKETS,
+    MetricsRegistry,
+    percentiles,
+    summarize_latencies,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))  # for scripts.telemetry_report
+
+from scripts.telemetry_report import aggregate_spans, render  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Tracer and faults are process globals; every test starts and ends
+    with both off (the session module is reset too, so a failing test
+    cannot leak an active session into the next)."""
+    faultinject.clear()
+    trace.disable()
+    trace.drain()
+    telemetry_session._active = None
+    yield
+    faultinject.clear()
+    telemetry_session.stop()
+    trace.disable()
+    trace.drain()
+
+
+# ----------------------------------------------------------------------
+# registry semantics
+
+
+def test_counter_monotonic_and_kind_conflict():
+    reg = MetricsRegistry()
+    c = reg.counter("reqs_total", "requests")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    assert c.value == 5  # the rejected delta did not land
+    assert reg.counter("reqs_total") is c  # get-or-create returns SAME obj
+    with pytest.raises(TypeError):
+        reg.gauge("reqs_total")  # a name means one thing
+    with pytest.raises(ValueError):
+        reg.counter("bad name with spaces")
+
+
+def test_gauge_set_and_callback():
+    reg = MetricsRegistry()
+    g = reg.gauge("depth")
+    g.set(3)
+    assert g.value == 3
+    backing = [7]
+    g.set_fn(lambda: backing[0])
+    backing[0] = 9
+    assert g.value == 9  # sampled at read time, the queue-depth idiom
+
+    def dead():
+        raise RuntimeError("queue gone")
+
+    g.set_fn(dead)
+    assert math.isnan(g.value)  # a dead callback must not kill a scrape
+
+
+def test_histogram_bucket_boundaries_le_inclusive():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", buckets=(0.01, 0.1, 1.0))
+    for v in (0.01, 0.1, 1.0, 5.0, 0.005):
+        h.observe(v)
+    # Prometheus convention: value == le lands IN that bucket (cumulative)
+    assert h.bucket_counts() == [
+        (0.01, 2),  # 0.005, 0.01
+        (0.1, 3),  # + 0.1
+        (1.0, 4),  # + 1.0
+        (math.inf, 5),  # + 5.0
+    ]
+    assert h.count == 5
+    assert h.sum == pytest.approx(6.115)
+    with pytest.raises(ValueError):
+        reg.histogram("bad", buckets=(1.0, 1.0))  # not strictly increasing
+    with pytest.raises(ValueError):
+        reg.histogram("bad2", buckets=(1.0, math.inf))  # finite bounds only
+
+
+def test_percentiles_and_summary_shims_are_the_one_implementation():
+    samples = [0.001 * i for i in range(1, 101)]
+    p = percentiles(samples)
+    assert p["p50"] == pytest.approx(np.percentile(samples, 50))
+    assert p["p99"] == pytest.approx(np.percentile(samples, 99))
+    s = summarize_latencies(samples)
+    assert s["count"] == 100
+    assert s["mean"] == pytest.approx(np.mean(samples))
+    assert s["p95"] == p["p95"]
+    empty = summarize_latencies([])
+    assert empty["count"] == 0 and math.isnan(empty["p50"])
+
+    # benchmarks/timing.py re-exports the SAME functions (satellite: one
+    # percentile implementation repo-wide)
+    sys.path.insert(0, str(REPO / "benchmarks"))
+    try:
+        import timing
+    finally:
+        sys.path.pop(0)
+    assert timing.percentiles is percentiles
+    assert timing.summarize_latencies is summarize_latencies
+
+
+def test_prometheus_text_golden():
+    reg = MetricsRegistry()
+    reg.counter("reqs_total", "requests seen").inc(3)
+    reg.gauge("depth").set(2.5)
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    assert reg.to_prometheus() == (
+        "# TYPE depth gauge\n"
+        "depth 2.5\n"
+        "# HELP lat_seconds latency\n"
+        "# TYPE lat_seconds histogram\n"
+        'lat_seconds_bucket{le="0.1"} 1\n'
+        'lat_seconds_bucket{le="1"} 2\n'
+        'lat_seconds_bucket{le="+Inf"} 2\n'
+        "lat_seconds_sum 0.55\n"
+        "lat_seconds_count 2\n"
+        "# HELP reqs_total requests seen\n"
+        "# TYPE reqs_total counter\n"
+        "reqs_total 3\n"
+    )
+
+
+# ----------------------------------------------------------------------
+# tracer: disabled-is-free contract, nesting, thread paths
+
+
+def test_disabled_span_is_shared_noop_singleton():
+    assert not trace.is_enabled()
+    s1 = trace.span("step/device_compute")
+    s2 = trace.span("anything/else")
+    assert s1 is s2  # ONE cached instance, no per-call allocation
+    with s1:
+        pass  # enter/exit are no-ops
+    assert trace.drain() == []  # and nothing was recorded
+
+
+def test_disabled_span_allocates_nothing():
+    """The hot loops keep their spans unconditionally; the disabled path
+    must not allocate (tracemalloc sees zero new blocks from trace.py)."""
+    assert not trace.is_enabled()
+    span = trace.span  # the bound method, as instrumentation sites use it
+    with span("warm/up"):
+        pass
+    tracemalloc.start()
+    try:
+        for _ in range(100):
+            with span("step/device_compute"):
+                pass
+        snap = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    trace_py = os.path.join("telemetry", "trace.py")
+    allocs = [
+        s for s in snap.statistics("filename")
+        if s.traceback[0].filename.endswith(trace_py)
+    ]
+    assert allocs == [], f"disabled span allocated: {allocs}"
+
+
+def test_enabled_spans_nest_and_time():
+    trace.enable()
+    with trace.span("serve/dispatch"):
+        with trace.span("serve/device"):
+            pass
+    events = trace.drain()
+    assert [e["name"] for e in events] == ["serve/device", "serve/dispatch"]
+    inner, outer = events
+    assert outer["path"] == "serve/dispatch"
+    assert inner["path"] == "serve/dispatch>serve/device"  # ">" = nesting
+    assert 0.0 <= inner["dur_s"] <= outer["dur_s"]
+    assert inner["ok"] and outer["ok"]
+    assert inner["ts"] >= outer["ts"]
+
+
+def test_span_records_failure_and_pops_stack():
+    trace.enable()
+    with pytest.raises(RuntimeError):
+        with trace.span("step/data_wait"):
+            raise RuntimeError("loader died")
+    with trace.span("step/device_compute"):
+        pass
+    bad, good = trace.drain()
+    assert bad["ok"] is False
+    assert good["path"] == "step/device_compute"  # stack popped on error
+
+
+# ----------------------------------------------------------------------
+# exporters: JSONL round-trip, durability under faults, .prom snapshot
+
+
+def test_jsonl_round_trip_and_torn_line_skip(tmp_path):
+    path = tmp_path / "events.jsonl"
+    with JsonlWriter(str(path), flush_every=2) as w:
+        w.write({"type": "span", "name": "a", "v": 1})
+        w.write({"type": "span", "name": "b", "np": np.float32(0.5)})
+    with open(path, "ab") as f:
+        f.write(b'{"type": "span", "na')  # a crash-torn trailing line
+    events = read_events(str(path))
+    assert [e["name"] for e in events] == ["a", "b"]
+    assert events[1]["np"] == 0.5  # numpy scalars serialized via .item()
+
+
+def test_jsonl_crash_fault_leaves_complete_lines(tmp_path):
+    """telemetry.write armed to crash on the SECOND flush: the first
+    flush's lines are durably on disk, the crashed flush's are not —
+    never a half-written record."""
+    path = tmp_path / "events.jsonl"
+    faultinject.inject("telemetry.write", "crash", at=2)
+    w = JsonlWriter(str(path), flush_every=1)
+    w.write({"n": 1})
+    with pytest.raises(InjectedFault):
+        w.write({"n": 2})
+    assert [e["n"] for e in read_events(str(path))] == [1]
+    faultinject.clear()
+    w.write({"n": 3})  # the writer survives an injected flush failure
+    w.close()
+    assert [e["n"] for e in read_events(str(path))] == [1, 2, 3]
+
+
+def test_write_prometheus_is_durable(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("x_total").inc()
+    path = tmp_path / PROM_NAME
+    write_prometheus(str(path), reg)
+    assert path.read_text().endswith("x_total 1\n")
+    assert (tmp_path / (PROM_NAME + ".sha256")).exists()  # durable sidecar
+
+    # mid-write crash (durable temp+rename discipline): no torn snapshot
+    faultinject.inject("telemetry.write", "crash")
+    reg.counter("x_total").inc()
+    with pytest.raises(InjectedFault):
+        write_prometheus(str(path), reg)
+    assert path.read_text().endswith("x_total 1\n")  # old snapshot intact
+
+
+def test_metric_events_mirror_snapshot():
+    reg = MetricsRegistry()
+    reg.counter("a_total").inc(2)
+    reg.histogram("b_seconds", buckets=DEFAULT_LATENCY_BUCKETS).observe(0.2)
+    events = metric_events(reg, ts=123.0)
+    assert {e["name"] for e in events} == {"a_total", "b_seconds"}
+    by_name = {e["name"]: e for e in events}
+    assert by_name["a_total"]["value"] == 2
+    assert by_name["a_total"]["ts"] == 123.0
+    assert by_name["b_seconds"]["count"] == 1
+
+
+# ----------------------------------------------------------------------
+# sessions + report math
+
+
+def test_session_round_trip_and_single_session_contract(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("pairs_total").inc(3)
+    telemetry_session.start(str(tmp_path), registry=reg, label="test")
+    with pytest.raises(RuntimeError):
+        telemetry_session.start(str(tmp_path / "other"))
+    with trace.span("eval/pair"):
+        pass
+    telemetry_session.stop()
+    telemetry_session.stop()  # idempotent
+
+    events = read_events(str(tmp_path / EVENTS_NAME))
+    kinds = [e["type"] for e in events]
+    assert kinds[0] == "meta" and "span" in kinds and "metric" in kinds
+    assert not trace.is_enabled()  # stop() disabled the tracer
+    prom = (tmp_path / PROM_NAME).read_text()
+    assert "pairs_total 3" in prom
+
+
+def test_report_self_time_math():
+    """self = total - direct children; span NAMES may contain '/' while
+    '>' is the nesting separator, so 'serve/dispatch' under no parent and
+    'serve/device' under it must resolve parentage correctly."""
+
+    def span(path, dur):
+        return {"type": "span", "path": path, "dur_s": dur,
+                "name": path.rsplit(">", 1)[-1]}
+
+    rows = aggregate_spans([
+        span("serve/dispatch", 1.0),
+        span("serve/dispatch", 1.0),
+        span("serve/dispatch>serve/device", 0.7),
+        span("serve/dispatch>serve/device", 0.5),
+        span("serve/dispatch>serve/device>step/loss_sync", 0.2),
+        span("eval/pair", 0.3),
+    ])
+    assert rows["serve/dispatch"]["count"] == 2
+    assert rows["serve/dispatch"]["total_s"] == pytest.approx(2.0)
+    assert rows["serve/dispatch"]["self_s"] == pytest.approx(0.8)
+    assert rows["serve/dispatch>serve/device"]["self_s"] == pytest.approx(1.0)
+    assert rows["eval/pair"]["self_s"] == pytest.approx(0.3)
+    text = render([
+        span("serve/dispatch", 1.0),
+        {"type": "metric", "name": "x_total", "kind": "counter", "value": 1},
+    ])
+    assert "== serve spans ==" in text and "x_total" in text
+
+
+# ----------------------------------------------------------------------
+# profiler window
+
+
+def test_parse_steps():
+    assert parse_steps("3:8") == (3, 8)
+    assert parse_steps("0:1") == (0, 1)
+    for bad in ("8:3", "3:3", "-1:2", "3", "a:b", ""):
+        with pytest.raises(ValueError):
+            parse_steps(bad)
+
+
+def test_profile_window_opens_and_closes_once(tmp_path, monkeypatch):
+    calls = []
+    monkeypatch.setattr(
+        jax.profiler, "start_trace", lambda d: calls.append(("start", d))
+    )
+    monkeypatch.setattr(
+        jax.profiler, "stop_trace", lambda: calls.append(("stop",))
+    )
+    synced = []
+    w = ProfileWindow(str(tmp_path), steps=(2, 4))
+    for step in range(6):
+        w.on_step(step, sync=lambda: synced.append(step))
+    w.close()  # idempotent after the window already closed
+    assert calls == [("start", str(tmp_path)), ("stop",)]
+    assert synced == [4]  # one D2H sync right before the trace closes
+
+    # disabled window (no dir): exact no-op
+    calls.clear()
+    w2 = ProfileWindow(None)
+    for step in range(6):
+        w2.on_step(step)
+    w2.close()
+    assert calls == []
+
+
+# ----------------------------------------------------------------------
+# serve-engine stats parity: report() is a registry view
+
+
+def test_engine_report_is_registry_view():
+    from ncnet_tpu.serve import ServeEngine, payload_spec
+
+    reg = MetricsRegistry()
+    params = {"w": jnp.asarray(3.0, jnp.float32)}
+
+    def apply(p, batch):
+        return {"y": batch["x"] * p["w"]}
+
+    with ServeEngine(
+        apply, params, max_batch=2, max_wait=0.01, registry=reg
+    ) as eng:
+        eng.warmup(
+            [("A", payload_spec({"x": np.zeros((4,), np.float32)}))]
+        )
+        futs = [
+            eng.submit(key="A", payload={"x": np.full((4,), float(i),
+                                                      np.float32)})
+            for i in range(3)
+        ]
+        for f in futs:
+            f.result(timeout=30)
+        stats = eng.report()
+
+    assert eng.metrics is reg  # the injected registry IS the stats store
+    assert stats["submitted"] == reg.get("serve_requests_submitted_total").value == 3
+    assert stats["completed"] == reg.get("serve_requests_completed_total").value == 3
+    assert stats["failed"] == reg.get("serve_requests_failed_total").value == 0
+    assert stats["batches"] == reg.get("serve_batches_total").value
+    assert stats["real_samples"] == reg.get("serve_samples_real_total").value
+    hist = reg.get("serve_request_latency_seconds")
+    assert hist.count == 3
+    assert stats["latencies_s"] == hist.samples
+    assert stats["latency_p50_ms"] == pytest.approx(
+        percentiles(hist.samples)["p50"] * 1e3
+    )
+    # a second engine without an injected registry gets a PRIVATE one
+    with ServeEngine(apply, params, max_batch=2, max_wait=0.01) as eng2:
+        assert eng2.metrics is not reg
+        assert eng2.metrics.get("serve_requests_submitted_total").value == 0
+
+
+# ----------------------------------------------------------------------
+# end to end: a tiny in-process training run under a telemetry session
+
+
+def test_train_loop_telemetry_end_to_end(tmp_path):
+    """The acceptance shape for scripts/train.py --telemetry, run
+    in-process (the CLI wires exactly this pair): a session around a
+    tiny train() produces events.jsonl with the per-step spans and the
+    train metrics, plus a renderable .prom snapshot."""
+    from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+    from ncnet_tpu.telemetry.registry import default_registry
+    from ncnet_tpu.train.loop import train as train_loop
+
+    cfg = ImMatchNetConfig(ncons_kernel_sizes=(3,), ncons_channels=(1,))
+    params = init_immatchnet(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(0)
+    batches = [
+        {"source_image": rng.randn(2, 48, 48, 3).astype(np.float32),
+         "target_image": rng.randn(2, 48, 48, 3).astype(np.float32)}
+        for _ in range(2)
+    ]
+    steps_before = default_registry().counter("train_steps_total").value
+
+    telem = tmp_path / "telem"
+    telemetry_session.start(str(telem), label="train-test")
+    try:
+        train_loop(
+            cfg, params, batches, val_loader=None, num_epochs=1,
+            checkpoint_dir=str(tmp_path), data_parallel=False, log_every=1,
+        )
+    finally:
+        telemetry_session.stop()
+
+    events = read_events(str(telem / EVENTS_NAME))
+    span_paths = {e["path"] for e in events if e["type"] == "span"}
+    # the step splits + the durable checkpoint span all recorded
+    assert "step/data_wait" in span_paths
+    assert "step/device_compute" in span_paths
+    assert "step/loss_sync" in span_paths
+    assert "checkpoint/save" in span_paths
+
+    metrics = {e["name"]: e for e in events if e["type"] == "metric"}
+    assert metrics["train_steps_total"]["value"] == steps_before + 2
+    assert metrics["train_step_seconds"]["count"] >= 2
+    assert metrics["train_mfu"]["value"] > 0  # analytic MFU gauge was set
+    assert metrics["checkpoint_bytes_written_total"]["value"] > 0
+
+    prom = (telem / PROM_NAME).read_text()
+    assert "# TYPE train_steps_total counter" in prom
+    assert "# TYPE train_step_seconds histogram" in prom
+    text = render(events)
+    assert "== step spans ==" in text and "== checkpoint spans ==" in text
