@@ -17,9 +17,33 @@ from ncnet_tpu.analysis.engine import (
     RULES,
     SEVERITY_ORDER,
     format_json,
+    format_sarif,
     format_text,
     lint_paths,
 )
+
+#: engine-level findings that carry no registered Rule (SARIF descriptors)
+ENGINE_PSEUDO_RULES = {
+    "syntax-error": {
+        "severity": "error",
+        "doc": "the file cannot be parsed as Python",
+    },
+    "bad-suppression": {
+        "severity": "error",
+        "doc": "an inline nclint suppression without a reason: every "
+               "silenced finding must say why the exception is safe",
+    },
+}
+
+
+def lint_rules_meta():
+    """{rule_id: {severity, doc}} over the full AST rule set, for SARIF."""
+    meta = {
+        r.rule_id: {"severity": r.severity, "doc": r.doc}
+        for r in RULES.values()
+    }
+    meta.update(ENGINE_PSEUDO_RULES)
+    return meta
 
 
 def main(argv=None):
@@ -30,8 +54,12 @@ def main(argv=None):
     )
     p.add_argument("paths", nargs="*", default=["."],
                    help="files or directories to lint (default: .)")
+    p.add_argument("--format", choices=["text", "json", "sarif"],
+                   default="text", dest="fmt",
+                   help="output format (default: human-readable text; "
+                        "json/sarif share the audit CLI's schema)")
     p.add_argument("--json", action="store_true",
-                   help="machine-readable JSON output")
+                   help="alias for --format json (back-compat)")
     p.add_argument("--fail-on", choices=sorted(SEVERITY_ORDER),
                    default="warning",
                    help="lowest severity that fails the run (default: "
@@ -56,7 +84,13 @@ def main(argv=None):
                     f"(see --list-rules)")
 
     findings = lint_paths(args.paths or ["."], selected)
-    print(format_json(findings) if args.json else format_text(findings))
+    fmt = "json" if args.json else args.fmt
+    if fmt == "json":
+        print(format_json(findings, tool="nclint"))
+    elif fmt == "sarif":
+        print(format_sarif(findings, "nclint", lint_rules_meta()))
+    else:
+        print(format_text(findings))
     threshold = SEVERITY_ORDER[args.fail_on]
     gating = [f for f in findings if SEVERITY_ORDER[f.severity] >= threshold]
     return 1 if gating else 0

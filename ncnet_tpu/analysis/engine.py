@@ -7,6 +7,16 @@ aliases, and yielding ``(node, message)`` pairs. The JAX/TPU-specific rule
 set lives in `ncnet_tpu.analysis.rules`; importing it populates the
 registry as a side effect.
 
+Interprocedural mode (the default for `lint_paths`, i.e. for the CI gate):
+before linting, every file in the run is parsed once into a
+`ProjectIndex` — a project-wide symbol table mapping dotted module names
+(derived from ``__init__.py`` package chains) to their top-level function
+definitions. Rules reach it as ``ctx.project`` and may follow a resolved
+call ONE level into another module (e.g. a compiled region calling a
+helper whose body hides a host sync). Single-file `lint_source` calls have
+``ctx.project = None`` and stay intra-module, so snippet-level golden
+tests and editor integrations are unchanged.
+
 Suppression contract (enforced, not advisory): a finding is silenced only
 by an inline directive ON THE FLAGGED LINE of the form
 
@@ -19,37 +29,93 @@ justification next to the code it excuses.
 
 import ast
 import dataclasses
-import json
 import os
 import re
-from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+from typing import Callable, Dict, Iterable, Iterator, List, NamedTuple, Optional, Tuple
 
-SEVERITY_ORDER = {"info": 0, "warning": 1, "error": 2}
+from ncnet_tpu.analysis.findings import (  # noqa: F401  (re-exported API)
+    SEVERITY_ORDER,
+    Finding,
+    format_json,
+    format_sarif,
+    format_text,
+    max_severity,
+)
 
 _SUPPRESS_RE = re.compile(
     r"#\s*nclint:\s*disable=([A-Za-z0-9_,\-]+)\s*(?:--\s*(\S.*))?"
 )
 
 
-@dataclasses.dataclass(frozen=True)
-class Finding:
-    """One lint finding, addressable as ``path:line:col``."""
+def module_name_for_path(path: str) -> str:
+    """Dotted module name for a file, walking the ``__init__.py`` chain.
 
+    ``.../ncnet_tpu/train/step.py`` -> ``ncnet_tpu.train.step`` because
+    every directory up to ``ncnet_tpu`` holds an ``__init__.py``;
+    ``scripts/train.py`` (no package) -> ``train``. This is what makes a
+    caller-side canonical name like ``ncnet_tpu.train.loss.weak_loss``
+    resolvable against the index regardless of where the lint run was
+    started from.
+    """
+    path = os.path.abspath(path)
+    parts = [os.path.splitext(os.path.basename(path))[0]]
+    d = os.path.dirname(path)
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        parts.append(os.path.basename(d))
+        d = os.path.dirname(d)
+    if parts[0] == "__init__":
+        parts = parts[1:] or [parts[0]]
+    return ".".join(reversed(parts))
+
+
+class FunctionInfo(NamedTuple):
+    """One indexed top-level function: where it lives + its parsed body."""
+
+    module: str
     path: str
-    line: int
-    col: int
-    rule: str
-    severity: str
-    message: str
+    node: ast.AST  # FunctionDef / AsyncFunctionDef
+    ctx: "ModuleContext"
 
-    def format(self) -> str:
-        return (
-            f"{self.path}:{self.line}:{self.col}: "
-            f"{self.severity} [{self.rule}] {self.message}"
-        )
 
-    def to_dict(self) -> dict:
-        return dataclasses.asdict(self)
+class ProjectIndex:
+    """Project-wide symbol table: dotted function name -> `FunctionInfo`.
+
+    Built once per lint run over every file in the run; rules use
+    `resolve` to follow a call site's canonical dotted name into the
+    defining module (one level deep — the callee's OWN calls are not
+    followed further, keeping findings explainable).
+    """
+
+    def __init__(self):
+        self.modules: Dict[str, "ModuleContext"] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+
+    @classmethod
+    def build(cls, files: Iterable[str]) -> "ProjectIndex":
+        idx = cls()
+        for path in files:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                tree = ast.parse(source, filename=path)
+            except (OSError, SyntaxError):
+                continue  # unreadable/unparseable files get their own finding
+            ctx = ModuleContext(tree, path, source)
+            mod = module_name_for_path(path)
+            idx.modules[mod] = ctx
+            for node in tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    idx.functions[f"{mod}.{node.name}"] = FunctionInfo(
+                        mod, path, node, ctx
+                    )
+        return idx
+
+    def resolve(self, canonical: Optional[str]) -> Optional[FunctionInfo]:
+        """`FunctionInfo` for a caller-side canonical dotted name, if the
+        name points at a top-level function of an indexed module."""
+        if not canonical:
+            return None
+        return self.functions.get(canonical)
 
 
 class ModuleContext:
@@ -59,12 +125,17 @@ class ModuleContext:
     its canonical dotted path through the module's imports, so rules match
     ``jax.numpy.max`` whether the source spells it ``jnp.max``,
     ``jax.numpy.max`` or ``from jax import numpy; numpy.max``.
+
+    ``project`` is the run-wide `ProjectIndex` in interprocedural runs
+    (`lint_paths`), else None — rules must degrade gracefully.
     """
 
-    def __init__(self, tree: ast.Module, path: str, source: str):
+    def __init__(self, tree: ast.Module, path: str, source: str,
+                 project: Optional[ProjectIndex] = None):
         self.tree = tree
         self.path = path
         self.source = source
+        self.project = project
         base = os.path.basename(path)
         parts = os.path.normpath(path).split(os.sep)
         self.is_test = (
@@ -153,7 +224,10 @@ def _parse_suppressions(source: str, path: str):
 
 
 def lint_source(
-    source: str, path: str, rules: Optional[Iterable[str]] = None
+    source: str,
+    path: str,
+    rules: Optional[Iterable[str]] = None,
+    project: Optional[ProjectIndex] = None,
 ) -> List[Finding]:
     """Lint one module's source text; returns unsuppressed findings."""
     try:
@@ -165,7 +239,7 @@ def lint_source(
                 f"cannot parse: {e.msg}",
             )
         ]
-    ctx = ModuleContext(tree, path, source)
+    ctx = ModuleContext(tree, path, source, project=project)
     suppressed, findings = _parse_suppressions(source, path)
     selected = (
         RULES.values() if rules is None
@@ -184,9 +258,13 @@ def lint_source(
     return findings
 
 
-def lint_file(path: str, rules: Optional[Iterable[str]] = None) -> List[Finding]:
+def lint_file(
+    path: str,
+    rules: Optional[Iterable[str]] = None,
+    project: Optional[ProjectIndex] = None,
+) -> List[Finding]:
     with open(path, encoding="utf-8") as f:
-        return lint_source(f.read(), path, rules)
+        return lint_source(f.read(), path, rules, project=project)
 
 
 def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
@@ -206,30 +284,16 @@ def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
 
 
 def lint_paths(
-    paths: Iterable[str], rules: Optional[Iterable[str]] = None
+    paths: Iterable[str],
+    rules: Optional[Iterable[str]] = None,
+    interprocedural: bool = True,
 ) -> List[Finding]:
+    """Lint files/directories; multi-file runs get a shared `ProjectIndex`
+    so rules can follow calls across modules (disable with
+    ``interprocedural=False`` for strictly per-file behaviour)."""
+    files = list(iter_python_files(paths))
+    project = ProjectIndex.build(files) if interprocedural else None
     findings: List[Finding] = []
-    for path in iter_python_files(paths):
-        findings.extend(lint_file(path, rules))
+    for path in files:
+        findings.extend(lint_file(path, rules, project=project))
     return findings
-
-
-def max_severity(findings: Iterable[Finding]) -> int:
-    return max(
-        (SEVERITY_ORDER[f.severity] for f in findings), default=-1
-    )
-
-
-def format_text(findings: List[Finding]) -> str:
-    lines = [f.format() for f in findings]
-    lines.append(
-        f"{len(findings)} finding(s)" if findings else "clean: 0 findings"
-    )
-    return "\n".join(lines)
-
-
-def format_json(findings: List[Finding]) -> str:
-    return json.dumps(
-        {"findings": [f.to_dict() for f in findings], "count": len(findings)},
-        indent=2,
-    )

@@ -1,0 +1,158 @@
+"""Shared findings model for the static analyzers (nclint + the jaxpr
+auditor), with text / JSON / SARIF emitters.
+
+One `Finding` shape for both engines means one gate contract: CI consumes
+`--format json` with a single schema, and `--format sarif` uploads to code
+scanning for inline annotations, regardless of whether the producer was the
+AST linter (`ncnet_tpu.analysis.engine`) or the program-level jaxpr auditor
+(`ncnet_tpu.analysis.jaxpr_audit`). The AST engine addresses findings as
+``path:line:col``; the auditor uses the pseudo-path ``jaxpr:<program>`` —
+SARIF treats both as artifact URIs.
+"""
+
+import dataclasses
+import json
+from typing import Dict, Iterable, List, Optional
+
+SEVERITY_ORDER = {"info": 0, "warning": 1, "error": 2}
+
+#: finding severity -> SARIF result level
+_SARIF_LEVEL = {"info": "note", "warning": "warning", "error": "error"}
+
+SCHEMA_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analyzer finding, addressable as ``path:line:col``.
+
+    ``detail`` carries rule-specific structured data (e.g. the auditor's
+    wasted-HBM byte counts or FLOP mismatch numbers) — optional, and
+    omitted from ``to_dict`` when empty so the JSON schema stays stable
+    for consumers that predate it.
+    """
+
+    path: str
+    line: int
+    col: int
+    rule: str
+    severity: str
+    message: str
+    detail: Optional[dict] = None
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.severity} [{self.rule}] {self.message}"
+        )
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        if d.get("detail") is None:
+            d.pop("detail", None)
+        return d
+
+
+def max_severity(findings: Iterable[Finding]) -> int:
+    return max((SEVERITY_ORDER[f.severity] for f in findings), default=-1)
+
+
+def format_text(findings: List[Finding]) -> str:
+    lines = [f.format() for f in findings]
+    lines.append(
+        f"{len(findings)} finding(s)" if findings else "clean: 0 findings"
+    )
+    return "\n".join(lines)
+
+
+def format_json(findings: List[Finding], tool: Optional[str] = None) -> str:
+    payload = {
+        "findings": [f.to_dict() for f in findings],
+        "count": len(findings),
+    }
+    if tool is not None:
+        payload["tool"] = tool
+        payload["schema_version"] = SCHEMA_VERSION
+    return json.dumps(payload, indent=2)
+
+
+def format_sarif(
+    findings: List[Finding],
+    tool_name: str,
+    rules_meta: Optional[Dict[str, dict]] = None,
+    tool_version: str = "0",
+) -> str:
+    """SARIF 2.1.0 for GitHub code scanning upload.
+
+    ``rules_meta``: ``{rule_id: {"severity": ..., "doc": ...}}`` — rules
+    referenced by findings but absent here still get a bare descriptor, so
+    the document always validates.
+    """
+    rules_meta = dict(rules_meta or {})
+    for f in findings:
+        rules_meta.setdefault(f.rule, {"severity": f.severity, "doc": ""})
+    rule_ids = sorted(rules_meta)
+    rule_index = {rid: i for i, rid in enumerate(rule_ids)}
+    descriptors = [
+        {
+            "id": rid,
+            "shortDescription": {"text": " ".join(
+                (rules_meta[rid].get("doc") or rid).split()
+            )[:512]},
+            "defaultConfiguration": {
+                "level": _SARIF_LEVEL.get(
+                    rules_meta[rid].get("severity", "warning"), "warning"
+                )
+            },
+        }
+        for rid in rule_ids
+    ]
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "ruleIndex": rule_index[f.rule],
+            "level": _SARIF_LEVEL.get(f.severity, "warning"),
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path.replace("\\", "/"),
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {
+                            "startLine": max(f.line, 1),
+                            "startColumn": max(f.col, 0) + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        if f.detail:
+            result["properties"] = f.detail
+        results.append(result)
+    doc = {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+            "Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": tool_name,
+                        "version": tool_version,
+                        "informationUri": (
+                            "https://github.com/GrumpyZhou/ncnet"
+                        ),
+                        "rules": descriptors,
+                    }
+                },
+                "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2)

@@ -1,0 +1,798 @@
+"""Program-level auditing: trace the repo's REAL entry programs to jaxprs
+and statically check the IR for the hazards source-level linting cannot see.
+
+`ncnet_tpu.analysis.engine` (nclint) reasons about source text; everything
+it can say stops at the trace boundary. This module picks up on the other
+side: each registered `ProgramSpec` builds one of the repo's actual entry
+programs — the jitted train step (dense / feature-cached / sparse-band),
+the serving engine's bucket program, the eval match fn — traces it with
+`jax.make_jaxpr`, and runs jaxpr rules over the resulting IR:
+
+  f64-leak               any float64/complex128 value in the program: on
+                         TPU f64 is emulated (orders of magnitude slower),
+                         and a leak usually means a numpy scalar promoted
+                         the whole chain
+  bf16-promotion-drift   f32 dot/conv ops inside a program whose config
+                         declares the bf16 compute path: each one silently
+                         gives back the bf16 win it was supposed to get
+  host-callback-in-jit   callback primitives (pure_callback /
+                         debug_callback / io_callback) compiled into the
+                         program: every execution round-trips to the host
+  missing-donation       declared-donatable args (carried train state, the
+                         serving batch) whose buffers are NOT donated —
+                         flagged with the wasted HBM bytes
+  oversized-constant     closure-captured arrays baked into the program as
+                         constants (weights captured instead of passed):
+                         they bloat the executable and dodge donation
+  flop-accounting-drift  an analytic FLOP walk over the jaxpr (dot_general
+                         + conv_general_dilated, recursing through
+                         scan/cond/remat sub-jaxprs) cross-checked against
+                         `ops.accounting.train_step_flops_for_batch`: a
+                         mismatch beyond tolerance means the telemetry MFU
+                         numerator (PR 7) has rotted
+
+Findings use the shared `analysis.findings.Finding` model with the
+pseudo-path ``jaxpr:<program>`` — `scripts/audit.py` emits them through
+the same text/JSON/SARIF formatters as nclint.
+
+Waivers are the auditor's suppression mechanism (same discipline as
+nclint's inline directives): a `ProgramSpec` may waive a rule with a
+MANDATORY reason; an empty reason is itself an error finding.
+"""
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ncnet_tpu.analysis.findings import SEVERITY_ORDER, Finding
+
+# --- traced-program model ----------------------------------------------------
+
+
+@dataclasses.dataclass
+class BuiltProgram:
+    """A concrete, traceable entry program.
+
+    ``fn`` must be a jit-wrapped callable (the trace looks for its pjit
+    equation); ``args`` are small-but-real example arguments.
+    ``declared_dtype`` names the compute dtype the config promises
+    ("bfloat16" enables the drift rule). ``donate_expect`` maps argnums
+    that SHOULD be donated to a human label for the finding.
+    ``expected_flops`` (when set) arms the accounting cross-check.
+    """
+
+    fn: Callable
+    args: Tuple[Any, ...]
+    declared_dtype: Optional[str] = None
+    donate_expect: Dict[int, str] = dataclasses.field(default_factory=dict)
+    expected_flops: Optional[float] = None
+    flop_tol: float = 0.02
+
+
+@dataclasses.dataclass
+class TracedProgram:
+    """One entry program traced to its compiled-side ClosedJaxpr."""
+
+    name: str
+    built: BuiltProgram
+    closed: Any  # inner ClosedJaxpr (the pjit body)
+    donated_invars: Tuple[bool, ...]
+    arg_leaves: List[List[Any]]  # per-argnum flattened concrete leaves
+    trace_seconds: float = 0.0
+
+    @property
+    def jaxpr(self):
+        return self.closed.jaxpr
+
+    def leaf_slice(self, argnum: int) -> Tuple[int, int]:
+        """[start, stop) positions of ``argnum``'s leaves in the flat
+        invar order (= the `donated_invars` index space)."""
+        start = sum(len(ls) for ls in self.arg_leaves[:argnum])
+        return start, start + len(self.arg_leaves[argnum])
+
+
+def _leaf_bytes(leaf) -> int:
+    arr = np.asarray(leaf) if not hasattr(leaf, "dtype") else leaf
+    return int(np.prod(arr.shape, dtype=np.int64)) * arr.dtype.itemsize if (
+        arr.shape
+    ) else arr.dtype.itemsize
+
+
+def _aval_bytes(aval) -> int:
+    size = int(np.prod(aval.shape, dtype=np.int64)) if aval.shape else 1
+    return size * np.dtype(aval.dtype).itemsize
+
+
+def trace_program(name: str, built: BuiltProgram) -> TracedProgram:
+    """Trace ``built.fn(*built.args)`` and unwrap the pjit equation.
+
+    The wrapper lambda keeps the jitted fn a CALL inside the outer trace,
+    so the jaxpr contains one ``pjit`` eqn whose params carry both the
+    inner ClosedJaxpr and ``donated_invars`` (aligned 1:1 with the
+    flattened argument leaves, in argument order). Closure-captured
+    arrays appear as the INNER jaxpr's consts — which is exactly what the
+    oversized-constant rule inspects.
+    """
+    import jax
+
+    t0 = time.perf_counter()
+    outer = jax.make_jaxpr(lambda *a: built.fn(*a))(*built.args)
+    dt = time.perf_counter() - t0
+    pjit_eqns = [e for e in outer.jaxpr.eqns if e.primitive.name == "pjit"]
+    if not pjit_eqns:
+        raise ValueError(
+            f"program {name!r}: no pjit equation in the trace — is "
+            "built.fn actually jit-wrapped?"
+        )
+    eqn = pjit_eqns[0]
+    arg_leaves = [list(jax.tree_util.tree_leaves(a)) for a in built.args]
+    return TracedProgram(
+        name=name,
+        built=built,
+        closed=eqn.params["jaxpr"],
+        donated_invars=tuple(eqn.params.get("donated_invars", ())),
+        arg_leaves=arg_leaves,
+        trace_seconds=dt,
+    )
+
+
+# --- generic IR walkers ------------------------------------------------------
+
+
+def _iter_sub_jaxprs(value) -> Iterator[Any]:
+    """Yield every Jaxpr inside an eqn param value (ClosedJaxpr unwrapped,
+    tuples/lists of branches — e.g. cond — walked)."""
+    if hasattr(value, "jaxpr") and hasattr(value, "consts"):  # ClosedJaxpr
+        yield value.jaxpr
+    elif hasattr(value, "eqns") and hasattr(value, "invars"):  # Jaxpr
+        yield value
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            yield from _iter_sub_jaxprs(v)
+
+
+def iter_eqns(jaxpr) -> Iterator[Tuple[Any, int]]:
+    """Every equation in the program, recursively, with the execution
+    multiplier its nesting implies (scan bodies run ``length`` times)."""
+    for e in jaxpr.eqns:
+        yield e, 1
+        mult = int(e.params.get("length", 1)) if (
+            e.primitive.name == "scan"
+        ) else 1
+        for v in e.params.values():
+            for sub in _iter_sub_jaxprs(v):
+                for inner_e, inner_m in iter_eqns(sub):
+                    yield inner_e, mult * inner_m
+
+
+def _prod(xs) -> float:
+    out = 1.0
+    for x in xs:
+        out *= float(x)
+    return out
+
+
+def eqn_flops(eqn) -> float:
+    """Analytic FLOPs (2*MACs) of ONE equation; 0 for non-contraction ops.
+
+    Elementwise/reduction work is deliberately excluded — it is noise
+    next to the contractions for every program in this repo, and
+    `ops.accounting` counts the same way, so the cross-check compares
+    like with like.
+    """
+    p = eqn.primitive.name
+    if p == "dot_general":
+        (lc, _rc), _batch = eqn.params["dimension_numbers"]
+        lhs = eqn.invars[0].aval
+        out = eqn.outvars[0].aval
+        return 2.0 * _prod(out.shape) * _prod(lhs.shape[d] for d in lc)
+    if p == "conv_general_dilated":
+        dn = eqn.params["dimension_numbers"]
+        rhs = eqn.invars[1].aval
+        out = eqn.outvars[0].aval
+        k_spatial = _prod(rhs.shape[d] for d in dn.rhs_spec[2:])
+        cin = rhs.shape[dn.rhs_spec[1]]  # per-group input channels
+        return 2.0 * _prod(out.shape) * k_spatial * cin
+    return 0.0
+
+
+def jaxpr_flops(jaxpr) -> float:
+    """Analytic FLOP walk over the whole program (scan-multiplied)."""
+    return sum(eqn_flops(e) * m for e, m in iter_eqns(jaxpr))
+
+
+def _iter_avals(jaxpr) -> Iterator[Any]:
+    """Every array type the program touches: inputs, consts, and each
+    equation output, recursively."""
+    seen = set()
+    stack = [jaxpr]
+    while stack:
+        j = stack.pop()
+        if id(j) in seen:
+            continue
+        seen.add(id(j))
+        for v in list(j.invars) + list(j.constvars) + list(j.outvars):
+            aval = getattr(v, "aval", None)
+            if aval is not None and hasattr(aval, "dtype"):
+                yield aval
+        for e in j.eqns:
+            for v in e.outvars:
+                aval = getattr(v, "aval", None)
+                if aval is not None and hasattr(aval, "dtype"):
+                    yield aval
+            for val in e.params.values():
+                stack.extend(_iter_sub_jaxprs(val))
+
+
+# --- jaxpr rule registry -----------------------------------------------------
+
+JaxprRuleFn = Callable[[TracedProgram], Iterator[Tuple[str, Optional[dict]]]]
+
+JAXPR_RULES: Dict[str, "JaxprRule"] = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class JaxprRule:
+    rule_id: str
+    severity: str
+    doc: str
+    fn: JaxprRuleFn
+
+
+def jaxpr_rule(rule_id: str, severity: str = "warning", doc: str = ""):
+    """Register a jaxpr rule; ``fn(traced)`` yields ``(message, detail)``."""
+    if severity not in SEVERITY_ORDER:
+        raise ValueError(f"unknown severity {severity!r}")
+
+    def wrap(fn: JaxprRuleFn) -> JaxprRuleFn:
+        if rule_id in JAXPR_RULES:
+            raise ValueError(f"duplicate jaxpr rule id {rule_id!r}")
+        JAXPR_RULES[rule_id] = JaxprRule(
+            rule_id, severity, doc or (fn.__doc__ or ""), fn
+        )
+        return fn
+
+    return wrap
+
+
+_WIDE_DTYPES = ("float64", "complex128")
+
+
+@jaxpr_rule(
+    "f64-leak",
+    "error",
+    doc="A float64/complex128 value inside the compiled program: TPUs "
+        "emulate f64 in software (orders of magnitude slower), and the "
+        "usual cause — an unannotated numpy scalar or np.float64 literal "
+        "— silently promotes everything downstream of it.",
+)
+def f64_leak(tp: TracedProgram) -> Iterator[Tuple[str, Optional[dict]]]:
+    hits: Dict[str, int] = {}
+    for aval in _iter_avals(tp.jaxpr):
+        dt = str(aval.dtype)
+        if dt in _WIDE_DTYPES:
+            hits[dt] = hits.get(dt, 0) + 1
+    for dt, n in sorted(hits.items()):
+        yield (
+            f"{n} {dt} value(s) in the program: f64 is software-emulated "
+            "on TPU — find the promoting literal/scalar and pin the dtype",
+            {"dtype": dt, "count": n},
+        )
+
+
+@jaxpr_rule(
+    "bf16-promotion-drift",
+    "warning",
+    doc="f32 dot/conv contractions inside a program whose config declares "
+        "the bf16 compute path (half_precision=True): each one runs at "
+        "the f32 rate and gives back the bf16 throughput the config "
+        "promised. f32 ELEMENTWISE ops are by design (final readout "
+        "cast, optimizer math) and not flagged.",
+)
+def bf16_promotion_drift(tp: TracedProgram) -> Iterator[Tuple[str, Optional[dict]]]:
+    if tp.built.declared_dtype != "bfloat16":
+        return
+    f32_heavy = 0
+    total_heavy = 0
+    for e, _m in iter_eqns(tp.jaxpr):
+        if e.primitive.name not in ("dot_general", "conv_general_dilated"):
+            continue
+        total_heavy += 1
+        if str(e.outvars[0].aval.dtype) == "float32":
+            f32_heavy += 1
+    if f32_heavy:
+        yield (
+            f"{f32_heavy}/{total_heavy} dot/conv op(s) run in float32 in a "
+            "declared-bf16 program: a promotion upstream is eating the "
+            "bf16 win — chase the first f32 operand",
+            {"f32_contractions": f32_heavy, "contractions": total_heavy},
+        )
+
+
+@jaxpr_rule(
+    "host-callback-in-jit",
+    "error",
+    doc="A callback primitive (pure_callback / debug_callback / "
+        "io_callback) compiled into the program: every execution "
+        "round-trips device->host->device, serializing the pipeline — "
+        "the compiled-side twin of nclint's host-sync-in-jit.",
+)
+def host_callback_in_jit(tp: TracedProgram) -> Iterator[Tuple[str, Optional[dict]]]:
+    hits: Dict[str, int] = {}
+    for e, _m in iter_eqns(tp.jaxpr):
+        if "callback" in e.primitive.name:
+            hits[e.primitive.name] = hits.get(e.primitive.name, 0) + 1
+    for prim, n in sorted(hits.items()):
+        yield (
+            f"{n} `{prim}` op(s) compiled into the program: each "
+            "execution stalls on a host round-trip; move the callback "
+            "outside jit or behind a debug flag",
+            {"primitive": prim, "count": n},
+        )
+
+
+@jaxpr_rule(
+    "missing-donation",
+    "warning",
+    doc="An argument the program's contract marks single-use (the carried "
+        "train state, the serving engine's padded batch) is NOT in "
+        "donate_argnums: XLA must allocate fresh output buffers while the "
+        "dead input still holds HBM — the flagged byte count is paid "
+        "every step.",
+)
+def missing_donation(tp: TracedProgram) -> Iterator[Tuple[str, Optional[dict]]]:
+    donated = tp.donated_invars
+    for argnum, label in sorted(tp.built.donate_expect.items()):
+        start, stop = tp.leaf_slice(argnum)
+        if stop > len(donated):
+            # donated_invars misaligned with the arg leaves (layout change
+            # upstream): surface it rather than silently passing
+            yield (
+                f"arg {argnum} ({label}): donation flags unavailable for "
+                "its leaves — pjit invar layout changed; audit needs "
+                "updating",
+                {"argnum": argnum},
+            )
+            continue
+        flags = donated[start:stop]
+        if all(flags):
+            continue
+        undonated = [
+            leaf for leaf, flag in zip(tp.arg_leaves[argnum], flags)
+            if not flag
+        ]
+        wasted = sum(_leaf_bytes(leaf) for leaf in undonated)
+        yield (
+            f"arg {argnum} ({label}) is not donated: "
+            f"{len(undonated)}/{len(flags)} leaf buffer(s), "
+            f"{wasted:,} wasted HBM bytes held across every call — add "
+            "it to donate_argnums",
+            {
+                "argnum": argnum,
+                "label": label,
+                "undonated_leaves": len(undonated),
+                "leaves": len(flags),
+                "wasted_bytes": wasted,
+            },
+        )
+
+
+#: constants below this size are legitimate program data (iotas, masks,
+#: norm epsilons); above it they are almost certainly captured weights
+OVERSIZED_CONST_BYTES = 1 << 20
+
+
+@jaxpr_rule(
+    "oversized-constant",
+    "warning",
+    doc="A large array captured by closure and baked into the program as "
+        "a constant (>= 1 MiB): captured weights bloat the serialized "
+        "executable, recompile on every value change, and can never be "
+        "donated — pass them as arguments instead.",
+)
+def oversized_constant(tp: TracedProgram) -> Iterator[Tuple[str, Optional[dict]]]:
+    for i, const in enumerate(tp.closed.consts):
+        if not hasattr(const, "dtype"):
+            continue
+        nbytes = _leaf_bytes(const)
+        if nbytes < OVERSIZED_CONST_BYTES:
+            continue
+        yield (
+            f"const #{i} ({tuple(const.shape)} {const.dtype}, "
+            f"{nbytes:,} bytes) is baked into the program: a closure "
+            "captured what should be an argument — weights passed as "
+            "args stay donatable and don't trigger recompiles",
+            {
+                "const_index": i,
+                "shape": list(const.shape),
+                "dtype": str(const.dtype),
+                "bytes": nbytes,
+            },
+        )
+
+
+@jaxpr_rule(
+    "flop-accounting-drift",
+    "warning",
+    doc="The analytic FLOP walk over the traced program disagrees with "
+        "`ops.accounting`'s closed-form count beyond tolerance: the "
+        "telemetry MFU gauge and bench.py report against the closed "
+        "form, so drift here means the utilization numbers are wrong.",
+)
+def flop_accounting_drift(tp: TracedProgram) -> Iterator[Tuple[str, Optional[dict]]]:
+    expected = tp.built.expected_flops
+    if not expected:
+        return
+    walked = jaxpr_flops(tp.jaxpr)
+    rel = abs(walked - expected) / expected
+    if rel > tp.built.flop_tol:
+        yield (
+            f"jaxpr FLOP walk {walked:,.0f} vs ops.accounting "
+            f"{expected:,.0f} ({rel:+.1%} drift, tol "
+            f"{tp.built.flop_tol:.0%}): the MFU numerator has rotted — "
+            "re-derive the closed form against this program",
+            {
+                "walked_flops": walked,
+                "expected_flops": expected,
+                "relative_drift": rel,
+                "tolerance": tp.built.flop_tol,
+            },
+        )
+
+
+# --- running rules over a traced program -------------------------------------
+
+
+def run_jaxpr_rules(
+    tp: TracedProgram,
+    waivers: Optional[Dict[str, str]] = None,
+    rules: Optional[Iterable[str]] = None,
+) -> Tuple[List[Finding], List[Finding]]:
+    """Run (selected) jaxpr rules over one traced program.
+
+    Returns ``(findings, waived)``. A waiver with an empty reason is
+    converted into a `bad-waiver` error — the same mandatory-reason
+    discipline as nclint's inline suppressions.
+    """
+    waivers = dict(waivers or {})
+    path = f"jaxpr:{tp.name}"
+    findings: List[Finding] = []
+    waived: List[Finding] = []
+    for rule_id, reason in sorted(waivers.items()):
+        if not (reason or "").strip():
+            findings.append(
+                Finding(
+                    path, 1, 0, "bad-waiver", "error",
+                    f"waiver for {rule_id!r} has no reason: every waived "
+                    "rule must say why the exception is safe",
+                )
+            )
+    selected = (
+        list(JAXPR_RULES.values()) if rules is None
+        else [JAXPR_RULES[r] for r in rules]
+    )
+    for r in selected:
+        for message, detail in r.fn(tp):
+            f = Finding(path, 1, 0, r.rule_id, r.severity, message, detail)
+            if r.rule_id in waivers and (waivers[r.rule_id] or "").strip():
+                waived.append(f)
+            else:
+                findings.append(f)
+    findings.sort(key=lambda f: (SEVERITY_ORDER[f.severity], f.rule),
+                  reverse=True)
+    return findings, waived
+
+
+def program_report(tp: TracedProgram) -> Dict[str, Any]:
+    """Per-program audit statistics (the human table's row)."""
+    n_eqns = sum(1 for _ in iter_eqns(tp.jaxpr))
+    bytes_in = sum(
+        _leaf_bytes(leaf) for leaves in tp.arg_leaves for leaf in leaves
+    )
+    flat = [leaf for leaves in tp.arg_leaves for leaf in leaves]
+    bytes_donated = sum(
+        _leaf_bytes(leaf)
+        for leaf, flag in zip(flat, tp.donated_invars)
+        if flag
+    )
+    bytes_out = sum(_aval_bytes(v.aval) for v in tp.jaxpr.outvars
+                    if hasattr(getattr(v, "aval", None), "dtype"))
+    bytes_const = sum(
+        _leaf_bytes(c) for c in tp.closed.consts if hasattr(c, "dtype")
+    )
+    walked = jaxpr_flops(tp.jaxpr)
+    report = {
+        "program": tp.name,
+        "eqns": n_eqns,
+        "flops_walked": walked,
+        "flops_expected": tp.built.expected_flops,
+        "bytes_in": bytes_in,
+        "bytes_out": bytes_out,
+        "bytes_const": bytes_const,
+        "bytes_donated": bytes_donated,
+        "trace_seconds": round(tp.trace_seconds, 3),
+    }
+    return report
+
+
+# --- the real entry-program registry -----------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramSpec:
+    """One auditable entry program: a name, what it is, how to build it,
+    and any waived rules (reason mandatory)."""
+
+    name: str
+    description: str
+    build: Callable[[], BuiltProgram]
+    waivers: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+#: audit-sized geometry: patch16 trunk (exact analytic FLOPs), 64x64
+#: images -> 4x4 feature grid, batch 2 — every program traces in <2 s on
+#: CPU, and every hazard class the rules check is shape-independent
+_IMAGE_SIDE = 64
+_GRID = _IMAGE_SIDE // 16
+_BATCH = 2
+_FEAT_CH = 256  # patch16 trunk channels (models/patch.py)
+
+
+def _audit_config(**overrides):
+    from ncnet_tpu.models.immatchnet import ImMatchNetConfig
+
+    return ImMatchNetConfig(
+        feature_extraction_cnn="patch16",
+        ncons_kernel_sizes=(3, 3),
+        ncons_channels=(4, 1),
+        **overrides,
+    )
+
+
+def _audit_params(config):
+    import jax
+
+    from ncnet_tpu.models.immatchnet import init_immatchnet
+
+    return init_immatchnet(jax.random.PRNGKey(0), config)
+
+
+def _image_batch():
+    rng = np.random.default_rng(0)
+    img = rng.standard_normal(
+        (_BATCH, _IMAGE_SIDE, _IMAGE_SIDE, 3)
+    ).astype(np.float32)
+    return {"source_image": img, "target_image": img.copy()}
+
+
+def _feature_batch():
+    rng = np.random.default_rng(0)
+    feat = rng.standard_normal(
+        (_BATCH, _GRID, _GRID, _FEAT_CH)
+    ).astype(np.float32)
+    return {"source_features": feat, "target_features": feat.copy()}
+
+
+def _build_train(nc_topk=0, from_features=False, half_precision=False):
+    from ncnet_tpu.ops.accounting import train_step_flops_for_batch
+    from ncnet_tpu.train.step import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    config = _audit_config(nc_topk=nc_topk, half_precision=half_precision)
+    params = _audit_params(config)
+    optimizer = make_optimizer()
+    state = create_train_state(params, optimizer)
+    step = make_train_step(config, optimizer, from_features=from_features)
+    batch = _feature_batch() if from_features else _image_batch()
+    expected = None
+    if not half_precision:
+        # the closed form models the f32 path; bf16 runs the same
+        # contractions at a different dtype, but the walk-vs-form check
+        # is owned by the f32 programs to keep one source of truth
+        expected = train_step_flops_for_batch(
+            config, batch, from_features=from_features, trunk_trainable=False
+        )
+    return BuiltProgram(
+        fn=step,
+        args=(state, batch),
+        declared_dtype="bfloat16" if half_precision else None,
+        donate_expect={0: "carried TrainState (params/opt_state/step)"},
+        expected_flops=expected,
+    )
+
+
+def _build_serve():
+    import jax
+
+    from ncnet_tpu.serve.engine import (
+        SERVE_DONATE_ARGNUMS,
+        make_serve_match_step,
+    )
+
+    config = _audit_config()
+    params = _audit_params(config)
+    apply_fn = make_serve_match_step(config)
+    # the same jit the engine builds in __init__ (minus the trace counter)
+    fn = jax.jit(apply_fn, donate_argnums=SERVE_DONATE_ARGNUMS)
+    return BuiltProgram(
+        fn=fn,
+        args=(params, _image_batch()),
+        donate_expect={
+            argnum: "single-use padded request batch"
+            for argnum in SERVE_DONATE_ARGNUMS
+        },
+    )
+
+
+def _build_eval_match():
+    import jax
+
+    from ncnet_tpu.eval.inloc import make_match_fn
+
+    config = _audit_config()
+    params = _audit_params(config)
+    fn = jax.jit(make_match_fn(config))
+    rng = np.random.default_rng(0)
+    src = rng.standard_normal(
+        (1, _IMAGE_SIDE, _IMAGE_SIDE, 3)
+    ).astype(np.float32)
+    return BuiltProgram(fn=fn, args=(params, src, src.copy()))
+
+
+PROGRAMS: Dict[str, ProgramSpec] = {
+    spec.name: spec
+    for spec in [
+        ProgramSpec(
+            "train/dense",
+            "dense NC training step (patch16 trunk, donated state)",
+            lambda: _build_train(),
+        ),
+        ProgramSpec(
+            "train/cached",
+            "feature-cache training step (zero trunk ops)",
+            lambda: _build_train(from_features=True),
+        ),
+        ProgramSpec(
+            "train/sparse",
+            "sparse-band (nc_topk) training step from cached features",
+            lambda: _build_train(nc_topk=4, from_features=True),
+        ),
+        ProgramSpec(
+            "train/dense-bf16",
+            "dense training step on the declared-bf16 compute path",
+            lambda: _build_train(half_precision=True),
+        ),
+        ProgramSpec(
+            "serve/bucket",
+            "serving engine bucket program (the warmup-compiled apply)",
+            _build_serve,
+        ),
+        ProgramSpec(
+            "eval/match",
+            "eval per-pair match fn (the InLoc dump's jitted forward)",
+            _build_eval_match,
+        ),
+    ]
+}
+
+
+@dataclasses.dataclass
+class AuditResult:
+    findings: List[Finding]
+    waived: List[Finding]
+    reports: List[Dict[str, Any]]
+    errors: List[Finding]  # programs that failed to build/trace
+
+    @property
+    def all_findings(self) -> List[Finding]:
+        return self.errors + self.findings
+
+
+def audit(
+    programs: Optional[Iterable[str]] = None,
+    rules: Optional[Iterable[str]] = None,
+) -> AuditResult:
+    """Build, trace, and rule-check the registered entry programs.
+
+    A program that fails to build or trace is itself an error finding
+    (``audit-trace-failure``) — the gate must not silently skip a broken
+    entry point.
+    """
+    names = list(programs) if programs is not None else sorted(PROGRAMS)
+    unknown = [n for n in names if n not in PROGRAMS]
+    if unknown:
+        raise KeyError(f"unknown audit program(s): {unknown}")
+    result = AuditResult([], [], [], [])
+    for name in names:
+        spec = PROGRAMS[name]
+        try:
+            traced = trace_program(name, spec.build())
+        except Exception as e:  # build/trace failure IS a finding
+            result.errors.append(
+                Finding(
+                    f"jaxpr:{name}", 1, 0, "audit-trace-failure", "error",
+                    f"program failed to build/trace: {type(e).__name__}: {e}",
+                )
+            )
+            continue
+        findings, waived = run_jaxpr_rules(traced, spec.waivers, rules)
+        result.findings.extend(findings)
+        result.waived.extend(waived)
+        result.reports.append(program_report(traced))
+    return result
+
+
+def rules_meta() -> Dict[str, dict]:
+    """{rule_id: {severity, doc}} for SARIF emission / --list-rules,
+    including the engine-level pseudo-rules."""
+    meta = {
+        r.rule_id: {"severity": r.severity, "doc": r.doc}
+        for r in JAXPR_RULES.values()
+    }
+    meta["bad-waiver"] = {
+        "severity": "error",
+        "doc": "a ProgramSpec waiver without a reason: every waived rule "
+               "must say why the exception is safe",
+    }
+    meta["audit-trace-failure"] = {
+        "severity": "error",
+        "doc": "a registered entry program failed to build or trace",
+    }
+    return meta
+
+
+def format_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:,.0f} {unit}" if unit == "B" else f"{n:,.1f} {unit}"
+        n /= 1024.0
+    return f"{n:,.1f} GiB"
+
+
+def format_flops(n: Optional[float]) -> str:
+    if n is None:
+        return "-"
+    if n >= 1e9:
+        return f"{n / 1e9:,.2f} G"
+    if n >= 1e6:
+        return f"{n / 1e6:,.2f} M"
+    return f"{n:,.0f}"
+
+
+def format_report_table(reports: List[Dict[str, Any]]) -> str:
+    """The telemetry_report-style human table over per-program stats."""
+    headers = [
+        "program", "eqns", "flops(walk)", "flops(form)", "in",
+        "donated", "out", "const", "trace s",
+    ]
+    rows = []
+    for r in reports:
+        rows.append([
+            r["program"],
+            str(r["eqns"]),
+            format_flops(r["flops_walked"]),
+            format_flops(r["flops_expected"]),
+            format_bytes(r["bytes_in"]),
+            format_bytes(r["bytes_donated"]),
+            format_bytes(r["bytes_out"]),
+            format_bytes(r["bytes_const"]),
+            f"{r['trace_seconds']:.2f}",
+        ])
+    widths = [
+        max(len(h), *(len(row[i]) for row in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    lines = [
+        "  ".join(h.ljust(w) for h, w in zip(headers, widths)).rstrip(),
+        "  ".join("-" * w for w in widths),
+    ]
+    for row in rows:
+        lines.append(
+            "  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
+        )
+    return "\n".join(lines)

@@ -36,9 +36,14 @@ arXiv:2004.10566, the low-precision normalization fragility):
                             telemetry tracer's contract); wall time is for
                             TIMESTAMP fields only
 
-All rules are intentionally conservative (intra-module reasoning only, one
-level of name expansion): a finding should mean something; the escape hatch
-for justified exceptions is the mandatory-reason inline suppression.
+All rules are intentionally conservative: a finding should mean something;
+the escape hatch for justified exceptions is the mandatory-reason inline
+suppression. In project runs (`lint_paths` builds a `ProjectIndex`),
+`host-sync-in-jit`, `recompile-hazard` and `process-zero-only-io`
+additionally follow a resolved call ONE level into its defining module —
+the callee's executed body is scanned (nested defs/lambdas pruned: they run
+on their own schedule), and the finding is reported at the CALLER's call
+site so the suppression lives where the decision is made.
 """
 
 import ast
@@ -112,6 +117,34 @@ def _func_nodes(tree: ast.AST):
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
             yield node
+
+
+def _walk_executed(fn: ast.AST) -> Iterator[ast.AST]:
+    """Nodes that execute when ``fn`` is CALLED: its body, with nested
+    FunctionDef/Lambda subtrees pruned (an inner def — a callback handed to
+    `jax.debug.callback`, a worker target — runs on its own schedule, so
+    its contents say nothing about the call itself)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _resolve_foreign_call(ctx: ModuleContext, node: ast.Call):
+    """(canonical_name, FunctionInfo) when ``node`` calls a top-level
+    function of ANOTHER indexed module; (name, None) otherwise. Same-module
+    calls stay with the intra-module reasoning of each rule."""
+    name = ctx.canonical(node.func)
+    project = ctx.project
+    if project is None:
+        return name, None
+    info = project.resolve(name)
+    if info is None or os.path.abspath(info.path) == os.path.abspath(ctx.path):
+        return name, None
+    return name, info
 
 
 def _is_jnp_call(ctx: ModuleContext, node: ast.AST) -> bool:
@@ -197,13 +230,37 @@ def _compiled_function_names(ctx: ModuleContext) -> Tuple[Set[ast.AST], Set[str]
     return roots, root_names
 
 
+def _host_sync_message(ctx: ModuleContext, node: ast.Call) -> Optional[str]:
+    """Why this single call is a host sync, or None. ``ctx`` must be the
+    module the call is WRITTEN in (its aliases decide canonicalization)."""
+    name = ctx.canonical(node.func)
+    if name in _HOST_SYNC_CALLS:
+        return _HOST_SYNC_CALLS[name]
+    if (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr in _HOST_SYNC_METHODS
+    ):
+        # method call on a VALUE (x.item()), not a module function
+        # (some.module.item would resolve through an import alias)
+        root = node.func.value
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        if isinstance(root, ast.Name) and root.id in ctx.aliases:
+            return None
+        return _HOST_SYNC_METHODS[node.func.attr]
+    return None
+
+
 @rule(
     "host-sync-in-jit",
     "warning",
     doc="Host-synchronizing calls (print/float/int/bool/np.asarray/.item/"
         ".tolist) reachable inside jit/shard_map/lax-control-flow bodies "
         "either fail on tracers or stall the device pipeline "
-        "(arXiv:1810.09868's host-sync trace hazard).",
+        "(arXiv:1810.09868's host-sync trace hazard). Project runs also "
+        "follow calls one level into other modules: a compiled body "
+        "calling a helper whose executed body syncs is reported at the "
+        "call site.",
 )
 def host_sync_in_jit(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
     roots, root_names = _compiled_function_names(ctx)
@@ -240,26 +297,33 @@ def host_sync_in_jit(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
             if not isinstance(node, ast.Call) or id(node) in seen:
                 continue
             seen.add(id(node))
-            name = ctx.canonical(node.func)
-            if name in _HOST_SYNC_CALLS:
-                yield node, (
-                    f"{_HOST_SYNC_CALLS[name]} (inside a compiled region)"
-                )
-            elif (
-                isinstance(node.func, ast.Attribute)
-                and node.func.attr in _HOST_SYNC_METHODS
-            ):
-                # method call on a VALUE (x.item()), not a module function
-                # (some.module.item would resolve through an import alias)
-                root = node.func.value
-                while isinstance(root, ast.Attribute):
-                    root = root.value
-                if isinstance(root, ast.Name) and root.id in ctx.aliases:
+            msg = _host_sync_message(ctx, node)
+            if msg is not None:
+                yield node, f"{msg} (inside a compiled region)"
+                continue
+            # interprocedural step: a compiled body calling a top-level
+            # function of ANOTHER module whose executed body syncs. The
+            # concretization builtins (int/float/bool) are excluded here:
+            # one call away from the trace they are overwhelmingly static
+            # shape/config casts, and flagging them would bury the
+            # high-signal syncs (.item/.tolist/device_get/np.asarray).
+            callee_name, info = _resolve_foreign_call(ctx, node)
+            if info is None:
+                continue
+            for sub in _walk_executed(info.node):
+                if not isinstance(sub, ast.Call):
                     continue
-                yield node, (
-                    f"{_HOST_SYNC_METHODS[node.func.attr]} "
-                    "(inside a compiled region)"
-                )
+                if info.ctx.canonical(sub.func) in ("int", "float", "bool"):
+                    continue
+                callee_msg = _host_sync_message(info.ctx, sub)
+                if callee_msg is not None:
+                    yield node, (
+                        f"call to {callee_name} (defined at "
+                        f"{os.path.basename(info.path)}:{sub.lineno}) "
+                        f"reaches a host sync inside this compiled region: "
+                        f"{callee_msg}"
+                    )
+                    break
 
 
 # --- unguarded-division -----------------------------------------------------
@@ -594,6 +658,50 @@ def _exits_scope(stmt: ast.AST) -> bool:
     )
 
 
+def _open_mode(node: ast.Call) -> Optional[str]:
+    mode = None
+    if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+        mode = node.args[1].value
+    for kw in node.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    return mode
+
+
+def _is_o_state_device_get(ctx: ModuleContext, node: ast.Call) -> bool:
+    """``jax.device_get`` whose argument names look like a whole
+    parameter/optimizer tree (not a scalar metric)."""
+    if ctx.canonical(node.func) != "jax.device_get":
+        return False
+    hay = []
+    for arg in node.args:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Name):
+                hay.append(sub.id)
+            elif isinstance(sub, ast.Attribute):
+                hay.append(sub.attr)
+    text = " ".join(hay).lower()
+    return any(h in text for h in _STATE_HINTS)
+
+
+def _is_artifact_wb_open(ctx: ModuleContext, node: ast.Call) -> bool:
+    """Bare ``open(path, "wb")`` whose path expression smells like a
+    resume-critical artifact (checkpoint/metrics/weights)."""
+    if ctx.canonical(node.func) != "open" or _open_mode(node) != "wb":
+        return False
+    hay: List[str] = []
+    if node.args:
+        for sub in ast.walk(node.args[0]):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                hay.append(sub.value)
+            elif isinstance(sub, ast.Name):
+                hay.append(sub.id)
+            elif isinstance(sub, ast.Attribute):
+                hay.append(sub.attr)
+    text = " ".join(hay).lower()
+    return any(h in text for h in _ARTIFACT_HINTS)
+
+
 @rule(
     "process-zero-only-io",
     "warning",
@@ -634,52 +742,41 @@ def process_zero_only_io(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
             if not isinstance(node, ast.Call) or id(node) in seen:
                 continue
             seen.add(id(node))
-            name = ctx.canonical(node.func)
-            if name == "jax.device_get":
-                hay = []
-                for arg in node.args:
-                    for sub in ast.walk(arg):
-                        if isinstance(sub, ast.Name):
-                            hay.append(sub.id)
-                        elif isinstance(sub, ast.Attribute):
-                            hay.append(sub.attr)
-                text = " ".join(hay).lower()
-                if any(h in text for h in _STATE_HINTS):
-                    yield node, (
-                        "O(state) jax.device_get behind a process-0 guard: "
-                        "one host gathers the full tree over DCN; write "
-                        "per-host shards instead (resilience.distributed / "
-                        "--distributed-checkpoints)"
-                    )
-            elif name == "open":
-                mode = None
-                if len(node.args) >= 2 and isinstance(
-                    node.args[1], ast.Constant
-                ):
-                    mode = node.args[1].value
-                for kw in node.keywords:
-                    if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
-                        mode = kw.value.value
-                if mode != "wb":
+            if _is_o_state_device_get(ctx, node):
+                yield node, (
+                    "O(state) jax.device_get behind a process-0 guard: "
+                    "one host gathers the full tree over DCN; write "
+                    "per-host shards instead (resilience.distributed / "
+                    "--distributed-checkpoints)"
+                )
+                continue
+            if _is_artifact_wb_open(ctx, node):
+                yield node, (
+                    "binary artifact write behind a process-0 guard: "
+                    "the whole save funnels through one host; use the "
+                    "per-host sharded layout (resilience.distributed)"
+                )
+                continue
+            # interprocedural step: the guarded region calling a function
+            # of ANOTHER module whose executed body does the O(state) I/O
+            callee_name, info = _resolve_foreign_call(ctx, node)
+            if info is None or info.ctx.is_test:
+                continue
+            if "resilience" in os.path.normpath(info.path).split(os.sep):
+                continue  # callee implements the sharded discipline
+            for sub in _walk_executed(info.node):
+                if not isinstance(sub, ast.Call):
                     continue
-                hay = []
-                if node.args:
-                    for sub in ast.walk(node.args[0]):
-                        if isinstance(sub, ast.Constant) and isinstance(
-                            sub.value, str
-                        ):
-                            hay.append(sub.value)
-                        elif isinstance(sub, ast.Name):
-                            hay.append(sub.id)
-                        elif isinstance(sub, ast.Attribute):
-                            hay.append(sub.attr)
-                text = " ".join(hay).lower()
-                if any(h in text for h in _ARTIFACT_HINTS):
+                if _is_o_state_device_get(info.ctx, sub) or \
+                        _is_artifact_wb_open(info.ctx, sub):
                     yield node, (
-                        "binary artifact write behind a process-0 guard: "
-                        "the whole save funnels through one host; use the "
-                        "per-host sharded layout (resilience.distributed)"
+                        f"call to {callee_name} (defined at "
+                        f"{os.path.basename(info.path)}:{sub.lineno}) does "
+                        "O(state) I/O behind this process-0 guard: one "
+                        "host funnels the full state; use the per-host "
+                        "sharded layout (resilience.distributed)"
                     )
+                    break
 
 
 # --- recompile-hazard -------------------------------------------------------
@@ -705,7 +802,9 @@ _FUNC_BOUNDARY = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
         "ncnet_tpu.serve's warm AOT executables exist to prevent). Hoist "
         "the jit to module scope, a factory return, or a one-time "
         "assignment; for deliberate per-shape compiles (benchmark sweeps) "
-        "suppress with a reason.",
+        "suppress with a reason. Project runs also flag a loop-body call "
+        "to a FACTORY in another module whose executed body constructs "
+        "jit/pmap (e.g. `make_train_step` called per iteration).",
 )
 def recompile_hazard(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
     if ctx.is_test:
@@ -736,11 +835,39 @@ def recompile_hazard(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
             p = parents.get(p)
         return False
 
+    def jit_construction_in(info) -> Optional[ast.AST]:
+        """First jit/pmap construction in a callee's EXECUTED body (nested
+        defs pruned: `jax.jit(step_fn)` at the factory's own level counts,
+        a jit inside a function the factory merely defines does not)."""
+        for sub in _walk_executed(info.node):
+            if isinstance(sub, ast.Call) and (
+                info.ctx.canonical(sub.func) in _JIT_CONSTRUCTORS
+            ):
+                return sub
+        return None
+
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
             continue
         name = ctx.canonical(node.func)
         if name not in _JIT_CONSTRUCTORS:
+            # interprocedural step: a loop body calling a foreign factory
+            # that constructs its own jit/pmap wrapper each call
+            if in_loop(node):
+                callee_name, info = _resolve_foreign_call(ctx, node)
+                if info is not None and not info.ctx.is_test:
+                    site = jit_construction_in(info)
+                    if site is not None:
+                        yield node, (
+                            f"{callee_name} (defined at "
+                            f"{os.path.basename(info.path)}:{site.lineno}) "
+                            "constructs a jit/pmap wrapper, and this call "
+                            "sits inside a loop body: every iteration gets "
+                            "a fresh compile cache and retraces; hoist the "
+                            "factory call out of the loop (or suppress "
+                            "with a reason for deliberate per-shape "
+                            "compile sweeps)"
+                        )
             continue
         short = name.rsplit(".", 1)[-1]
         parent = parents.get(node)

@@ -14,16 +14,44 @@ Pure arithmetic over config-shaped integers; no jax, no module state
 V5E_BF16_PEAK_FLOPS = 197e12
 
 
+def trunk_forward_flops(cnn, image):
+    """Trunk forward FLOPs (2*MACs) per image at ``image``x``image``.
+
+    patch16 is exact (one 16x16/stride-16 conv to 256 channels —
+    ``models/patch.py``): ``2 * g^2 * (16*16*3) * 256`` with
+    ``g = image // 16``. resnet101 keeps the calibrated conv1..layer3
+    anchor (6.5 GFLOPs @ 224, quadratic in side). Other trunks fall back
+    to the resnet101 curve — callers needing exactness for them should
+    extend this table (the jaxpr auditor cross-checks it per-trunk).
+    """
+    if cnn == "patch16":
+        g = max(int(image) // 16, 1)
+        return 2.0 * g * g * (16 * 16 * 3) * 256
+    resnet101_layer3_224 = 6.5e9  # conv1..layer3 @ 224x224 per image
+    return resnet101_layer3_224 * (image / 224.0) ** 2
+
+
 def train_step_flops(batch, kernels, channels, grid=25, feat_ch=1024,
-                     image=400, from_features=False, nc_topk=0):
+                     image=400, from_features=False, nc_topk=0,
+                     cnn="resnet101", trunk_trainable=False):
     """Analytic FLOPs (2*MACs) per training step.
 
     Counted: 2 trunk forwards/sample (features reused for the rolled
     negatives), pos+neg correlation einsums, the symmetric NC stack
-    forward for pos+neg, and its backward (~2x forward; the frozen trunk
-    takes no backward). With ``from_features`` (the feature cache,
+    forward for pos+neg, and its backward (the frozen trunk takes no
+    backward). With ``from_features`` (the feature cache,
     ncnet_tpu.features) the step contains ZERO backbone ops, so the trunk
     term drops out and MFU is reported against the reduced count.
+
+    The backward term is AD-exact, not the 2x-forward folklore: with a
+    frozen trunk the correlation volume is param-independent, so JAX
+    prunes the FIRST NC layer's input cotangent (dx_1) from the dense
+    backward — the count subtracts that layer's dx work unless
+    ``trunk_trainable`` (gradients must flow through corr back into the
+    trunk) or ``nc_topk`` (the sparse band's custom VJP computes dx
+    unconditionally). Verified against a jaxpr FLOP walk by
+    ``ncnet_tpu.analysis.jaxpr_audit`` — mismatch there is a finding, so
+    this count (the telemetry MFU numerator) cannot silently rot.
 
     With ``nc_topk`` > 0 (sparse band, ncnet_tpu.sparse) the NC layers
     run on ``hA*wA * K`` band entries instead of the dense
@@ -33,33 +61,42 @@ def train_step_flops(batch, kernels, channels, grid=25, feat_ch=1024,
     build, and gathers are integer/comparison work and are not counted
     (the correlation einsum, which the sparse path still runs, is).
     """
-    resnet101_layer3_224 = 6.5e9  # conv1..layer3 @ 224x224 per image
-    trunk = 2 * resnet101_layer3_224 * (image / 224.0) ** 2
-    if from_features:
-        trunk = 0.0
+    trunk = 0.0 if from_features else 2 * trunk_forward_flops(cnn, image)
     corr = 2 * 2.0 * grid**4 * feat_ch  # pos + neg
     n_b = grid**2 if not nc_topk else min(int(nc_topk), grid**2)
     nc_channels = [1, *channels]
-    nc_pass = sum(
+    layer_flops = [
         2.0 * grid**2 * n_b * k**4 * cin * cout
         for k, cin, cout in zip(kernels, nc_channels[:-1], nc_channels[1:])
-    )
+    ]
+    nc_pass = sum(layer_flops)
     nc_fwd = nc_pass * 2 * 2  # symmetric x (pos + neg)
     nc_bwd = 2 * nc_fwd
+    if layer_flops and not trunk_trainable and not nc_topk:
+        # dense frozen-trunk backward: dx_1 (input cotangent of the first
+        # NC layer) is dead — corr depends on no trainable param — and JAX
+        # AD prunes it; one dx pass of layer 1, x2 symmetric x2 pos/neg
+        nc_bwd -= layer_flops[0] * 2 * 2
     return batch * (trunk + corr + nc_fwd + nc_bwd)
 
 
-def train_step_flops_for_batch(config, batch, from_features=False):
+def train_step_flops_for_batch(config, batch, from_features=False,
+                               trunk_trainable=False):
     """`train_step_flops` derived from a config + a concrete batch dict.
 
     ``batch`` maps names to ``[b, h, w, ...]`` arrays: images
     (``source_image``) on the raw-pixel path, ``[b, gh, gw, c]`` feature
     maps (``source_features``) on the cached path. The trunk term uses
-    the image side (stride-16 backbone: grid = side // 16); the analytic
-    count assumes a square grid, which both the training datasets and
-    the synthetic benches satisfy.
+    the image side (stride-16 backbone: grid = side // 16) and the
+    config's trunk (patch16 features are 256-channel, the resnet-family
+    layer3 features 1024); the analytic count assumes a square grid,
+    which both the training datasets and the synthetic benches satisfy.
+    ``trunk_trainable`` mirrors ``train_fe or fe_finetune_blocks > 0``
+    at the call site — it keeps the first NC layer's input-cotangent
+    work in the backward count (see `train_step_flops`).
     """
     from_features = from_features or "source_features" in batch
+    cnn = getattr(config, "feature_extraction_cnn", "resnet101")
     arr = (
         batch["source_features"]
         if "source_features" in batch
@@ -70,7 +107,8 @@ def train_step_flops_for_batch(config, batch, from_features=False):
         grid, feat_ch, image = int(arr.shape[1]), int(arr.shape[-1]), 0
     else:
         image = int(arr.shape[1])
-        grid, feat_ch = max(image // 16, 1), 1024
+        grid = max(image // 16, 1)
+        feat_ch = 256 if cnn == "patch16" else 1024
     return train_step_flops(
         b,
         config.ncons_kernel_sizes,
@@ -80,4 +118,6 @@ def train_step_flops_for_batch(config, batch, from_features=False):
         image=image,
         from_features=from_features,
         nc_topk=int(getattr(config, "nc_topk", 0)),
+        cnn=cnn,
+        trunk_trainable=trunk_trainable,
     )

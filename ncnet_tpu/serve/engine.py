@@ -68,6 +68,15 @@ def payload_spec(payload):
     }
 
 
+#: donation spec for every serving program: argnum 1 is the padded input
+#: batch — single-use per dispatch (`_dispatch_inner` stacks fresh numpy
+#: each time, and nothing reads it after the call), so XLA may reuse its
+#: HBM for outputs. Argnum 0 (params) is reused across every dispatch and
+#: must NEVER be donated. `ncnet_tpu.analysis.jaxpr_audit` checks the
+#: compiled programs against this spec.
+SERVE_DONATE_ARGNUMS = (1,)
+
+
 def make_serve_match_step(config, softmax=True, from_features=False):
     """The serving apply fn for the correspondence workload:
     ``apply(params, batch) -> {'matches': [b, 5, n]}``.
@@ -162,7 +171,7 @@ class ServeEngine:
             self._trace_count += 1
             return apply_fn(p, batch)
 
-        self._jit = jax.jit(_counted_apply)
+        self._jit = jax.jit(_counted_apply, donate_argnums=SERVE_DONATE_ARGNUMS)
         self._compiled = {}  # (bucket key, padded size) -> executable
         self._compile_lock = threading.Lock()
         self._warm = False

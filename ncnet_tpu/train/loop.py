@@ -407,7 +407,8 @@ def _train_impl(
                 m_step_ms.set(ms)
                 m_mfu.set(
                     train_step_flops_for_batch(
-                        config, dbatch, from_features=from_features
+                        config, dbatch, from_features=from_features,
+                        trunk_trainable=train_fe or fe_finetune_blocks > 0,
                     )
                     / (max(ms, 1e-6) / 1e3 * V5E_BF16_PEAK_FLOPS)
                 )

@@ -168,6 +168,13 @@ def make_train_step(
     batch sharded over the data axis and params replicated, XLA inserts
     the gradient all-reduce automatically; no hand-written collectives
     needed.
+
+    Audit contract (``scripts/audit.py``, programs ``train/*``): the
+    carried ``state`` (argnum 0) is donated — the jaxpr gate's
+    ``missing-donation`` rule fails if that regresses — the compiled
+    program contains no f64 values and no host callbacks, and its
+    walked dot/conv FLOPs must equal ``ops.accounting.train_step_flops``
+    exactly (the telemetry MFU numerator).
     """
     check_sparse_config(config)
     if from_features:

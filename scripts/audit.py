@@ -1,0 +1,116 @@
+"""`audit` — trace the repo's real entry programs and check the compiled IR.
+
+The program-level counterpart of `scripts/lint.py`: where nclint reads
+source text, this traces the ACTUAL jitted train/serve/eval programs to
+jaxprs (`ncnet_tpu.analysis.jaxpr_audit`) and checks the IR for f64
+leaks, bf16 promotion drift, compiled-in host callbacks, missing buffer
+donation, closure-captured constants, and FLOP-accounting drift against
+`ops.accounting` (the telemetry MFU numerator).
+
+Exit status is 0 only when no unsuppressed finding at or above
+``--fail-on`` remains — the CI gate is simply
+
+    JAX_PLATFORMS=cpu python scripts/audit.py
+
+Output defaults to a human table (per-program stats + findings); with
+``--format json|sarif`` it shares the `Finding` schema nclint emits, so
+one consumer handles both analyzers.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from ncnet_tpu.analysis.findings import (  # noqa: E402
+    SEVERITY_ORDER,
+    format_json,
+    format_sarif,
+    format_text,
+)
+from ncnet_tpu.analysis.jaxpr_audit import (  # noqa: E402
+    JAXPR_RULES,
+    PROGRAMS,
+    audit,
+    format_report_table,
+    rules_meta,
+)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="audit",
+        description="jaxpr-level audit of the repo's real entry programs "
+                    "(rule catalog: ncnet_tpu/analysis/README.md)",
+    )
+    p.add_argument("--programs", default="",
+                   help="comma-separated program names to audit "
+                        "(default: all; see --list-programs)")
+    p.add_argument("--format", choices=["text", "json", "sarif"],
+                   default="text", dest="fmt",
+                   help="output format (default: human table)")
+    p.add_argument("--fail-on", choices=sorted(SEVERITY_ORDER),
+                   default="warning",
+                   help="lowest severity that fails the run (default: "
+                        "warning)")
+    p.add_argument("--select", default="",
+                   help="comma-separated jaxpr rule ids to run "
+                        "(default: all)")
+    p.add_argument("--list-programs", action="store_true",
+                   help="print the entry-program registry and exit")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the jaxpr rule catalog and exit")
+    args = p.parse_args(argv)
+
+    if args.list_programs:
+        for name in sorted(PROGRAMS):
+            spec = PROGRAMS[name]
+            print(f"{name}: {spec.description}")
+            for rule_id, reason in sorted(spec.waivers.items()):
+                print(f"  waived {rule_id}: {reason}")
+        return 0
+    if args.list_rules:
+        for r in sorted(JAXPR_RULES.values(), key=lambda r: r.rule_id):
+            print(f"{r.rule_id} ({r.severity}): {' '.join(r.doc.split())}")
+        return 0
+
+    programs = None
+    if args.programs:
+        programs = [s.strip() for s in args.programs.split(",") if s.strip()]
+        unknown = [s for s in programs if s not in PROGRAMS]
+        if unknown:
+            p.error(f"unknown program(s): {', '.join(unknown)} "
+                    f"(see --list-programs)")
+    selected = None
+    if args.select:
+        selected = [s.strip() for s in args.select.split(",") if s.strip()]
+        unknown = [s for s in selected if s not in JAXPR_RULES]
+        if unknown:
+            p.error(f"unknown rule id(s): {', '.join(unknown)} "
+                    f"(see --list-rules)")
+
+    result = audit(programs, selected)
+    findings = result.all_findings
+
+    if args.fmt == "json":
+        print(format_json(findings, tool="audit"))
+    elif args.fmt == "sarif":
+        print(format_sarif(findings, "audit", rules_meta()))
+    else:
+        print(format_report_table(result.reports))
+        if result.waived:
+            print(f"\n{len(result.waived)} waived finding(s):")
+            for f in result.waived:
+                print(f"  {f.format()}")
+        print()
+        print(format_text(findings))
+    threshold = SEVERITY_ORDER[args.fail_on]
+    gating = [f for f in findings if SEVERITY_ORDER[f.severity] >= threshold]
+    return 1 if gating else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

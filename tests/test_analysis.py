@@ -833,3 +833,216 @@ def test_repo_suppressions_all_carry_reasons():
                         f"{line.strip()}"
                     )
     assert n_directives >= 1, "expected at least one real suppression"
+
+
+# --- interprocedural mode (ProjectIndex) ------------------------------------
+
+
+def _write_pkg(tmp_path, files):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    for name, src in files.items():
+        (pkg / name).write_text(src)
+    return tmp_path
+
+
+HELPER_SYNC = """
+def fetch_scalar(x):
+    return x.item()
+"""
+
+CALLER_SYNC = """
+import jax
+from pkg.helper import fetch_scalar
+
+@jax.jit
+def step(x):
+    return fetch_scalar(x) + 1
+"""
+
+
+def test_interprocedural_host_sync_reported_at_call_site(tmp_path):
+    root = _write_pkg(
+        tmp_path, {"helper.py": HELPER_SYNC, "caller.py": CALLER_SYNC}
+    )
+    fs = [f for f in lint_paths([str(root)]) if f.rule == "host-sync-in-jit"]
+    assert len(fs) == 1
+    assert fs[0].path.endswith("caller.py")  # caller owns the suppression
+    assert "fetch_scalar" in fs[0].message
+    assert "helper.py" in fs[0].message  # finding names the callee's home
+
+
+def test_interprocedural_host_sync_prunes_nested_defs(tmp_path):
+    # the sync lives in an INNER def (a host-side callback the helper
+    # merely defines) — calling the helper from jit is fine
+    helper = """
+def make_logger():
+    def log(x):
+        return x.item()
+    return log
+"""
+    caller = """
+import jax
+from pkg.helper import make_logger
+
+@jax.jit
+def step(x):
+    logger = make_logger()
+    return x + 1
+"""
+    root = _write_pkg(tmp_path, {"helper.py": helper, "caller.py": caller})
+    fs = [f for f in lint_paths([str(root)]) if f.rule == "host-sync-in-jit"]
+    assert fs == []
+
+
+def test_interprocedural_host_sync_skips_static_casts(tmp_path):
+    # int()/float()/bool() one call away are overwhelmingly static
+    # shape/config casts — the cross-module step must not flag them
+    helper = """
+def grid_side(x):
+    side = int(x.shape[0] ** 0.5)
+    return side
+"""
+    root = _write_pkg(
+        tmp_path,
+        {"helper.py": helper, "caller.py": CALLER_SYNC.replace(
+            "fetch_scalar", "grid_side"
+        ).replace("pkg.helper import grid_side", "pkg.helper import grid_side")},
+    )
+    fs = [f for f in lint_paths([str(root)]) if f.rule == "host-sync-in-jit"]
+    assert fs == []
+
+
+def test_single_source_lint_stays_intraprocedural():
+    # lint_source has no ProjectIndex: the cross-module call cannot be
+    # resolved and must not crash or fabricate findings
+    assert findings_for(CALLER_SYNC, only="host-sync-in-jit") == []
+
+
+FACTORY = """
+import jax
+
+def make_step(fn):
+    return jax.jit(fn)
+"""
+
+LOOP_CALLER = """
+from pkg.factory import make_step
+
+def sweep(fns, x):
+    outs = []
+    for fn in fns:
+        step = make_step(fn)
+        outs.append(step(x))
+    return outs
+"""
+
+HOISTED_CALLER = """
+from pkg.factory import make_step
+
+def run(fn, xs):
+    step = make_step(fn)
+    return [step(x) for x in xs]
+"""
+
+
+def test_interprocedural_recompile_hazard_factory_in_loop(tmp_path):
+    root = _write_pkg(
+        tmp_path, {"factory.py": FACTORY, "caller.py": LOOP_CALLER}
+    )
+    fs = [f for f in lint_paths([str(root)]) if f.rule == "recompile-hazard"]
+    assert len(fs) == 1
+    assert fs[0].path.endswith("caller.py")
+    assert "make_step" in fs[0].message
+
+
+def test_interprocedural_recompile_hazard_hoisted_clean(tmp_path):
+    root = _write_pkg(
+        tmp_path, {"factory.py": FACTORY, "caller.py": HOISTED_CALLER}
+    )
+    fs = [f for f in lint_paths([str(root)]) if f.rule == "recompile-hazard"]
+    assert fs == []
+
+
+SAVER = """
+import jax
+
+def snapshot(state, path):
+    host_params = jax.device_get(state.params)
+    return host_params
+"""
+
+GUARDED_CALLER = """
+import jax
+from pkg.saver import snapshot
+
+def maybe_save(state, path):
+    if jax.process_index() == 0:
+        snapshot(state, path)
+"""
+
+
+def test_interprocedural_process_zero_io(tmp_path):
+    root = _write_pkg(
+        tmp_path, {"saver.py": SAVER, "caller.py": GUARDED_CALLER}
+    )
+    fs = [
+        f for f in lint_paths([str(root)])
+        if f.rule == "process-zero-only-io"
+    ]
+    assert len(fs) == 1
+    assert fs[0].path.endswith("caller.py")
+    assert "snapshot" in fs[0].message
+
+
+def test_interprocedural_process_zero_io_unguarded_clean(tmp_path):
+    unguarded = """
+from pkg.saver import snapshot
+
+def always_save(state, path):
+    snapshot(state, path)
+"""
+    root = _write_pkg(tmp_path, {"saver.py": SAVER, "caller.py": unguarded})
+    fs = [
+        f for f in lint_paths([str(root)])
+        if f.rule == "process-zero-only-io"
+    ]
+    assert fs == []
+
+
+def test_project_index_module_names_and_resolution(tmp_path):
+    from ncnet_tpu.analysis.engine import (
+        ProjectIndex,
+        iter_python_files,
+        module_name_for_path,
+    )
+
+    root = _write_pkg(tmp_path, {"helper.py": HELPER_SYNC})
+    sub = root / "pkg" / "sub"
+    sub.mkdir()
+    (sub / "__init__.py").write_text("")
+    (sub / "deep.py").write_text("def leaf():\n    return 1\n")
+
+    assert module_name_for_path(str(root / "pkg" / "helper.py")) == (
+        "pkg.helper"
+    )
+    assert module_name_for_path(str(sub / "deep.py")) == "pkg.sub.deep"
+
+    idx = ProjectIndex.build(iter_python_files([str(root)]))
+    assert idx.resolve("pkg.helper.fetch_scalar") is not None
+    assert idx.resolve("pkg.sub.deep.leaf") is not None
+    assert idx.resolve("pkg.sub.deep.missing") is None
+    assert idx.resolve(None) is None
+
+
+def test_lint_paths_interprocedural_opt_out(tmp_path):
+    root = _write_pkg(
+        tmp_path, {"helper.py": HELPER_SYNC, "caller.py": CALLER_SYNC}
+    )
+    fs = [
+        f
+        for f in lint_paths([str(root)], interprocedural=False)
+        if f.rule == "host-sync-in-jit"
+    ]
+    assert fs == []

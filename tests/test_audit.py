@@ -1,0 +1,425 @@
+"""Golden-finding tests for the jaxpr auditor (ncnet_tpu.analysis.jaxpr_audit).
+
+Each jaxpr rule gets a synthetic jitted program that PROVABLY violates it
+(the f64 leak, the captured constant, the omitted donation, ...) plus a
+clean twin — the executable form of the rule catalog — and the end-to-end
+gate: auditing the repo's REAL train/serve/eval entry programs yields zero
+unsuppressed findings, with the analytic FLOP walk agreeing with
+`ops.accounting`'s closed form.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.analysis.findings import Finding, format_sarif
+from ncnet_tpu.analysis.jaxpr_audit import (
+    JAXPR_RULES,
+    PROGRAMS,
+    BuiltProgram,
+    audit,
+    format_report_table,
+    jaxpr_flops,
+    rules_meta,
+    run_jaxpr_rules,
+    trace_program,
+)
+
+
+def run_rules(built, waivers=None, rules=None, name="synthetic"):
+    tp = trace_program(name, built)
+    return run_jaxpr_rules(tp, waivers, rules)
+
+
+# --- f64-leak ----------------------------------------------------------------
+
+
+def test_f64_leak_golden():
+    @jax.jit
+    def leaky(x):
+        # the classic promotion: an explicit f64 cast (stand-in for an
+        # unannotated numpy double scalar) drags the chain to f64
+        return jnp.asarray(x, jnp.float64) * 2.0
+
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        tp = trace_program(
+            "syn/f64", BuiltProgram(fn=leaky, args=(np.ones(4, np.float32),))
+        )
+    findings, _ = run_jaxpr_rules(tp, rules=["f64-leak"])
+    assert [f.rule for f in findings] == ["f64-leak"]
+    assert findings[0].severity == "error"
+    assert findings[0].detail["dtype"] == "float64"
+
+
+def test_f64_leak_clean_on_f32():
+    @jax.jit
+    def fine(x):
+        return x * 2.0
+
+    findings, _ = run_rules(
+        BuiltProgram(fn=fine, args=(np.ones(4, np.float32),)),
+        rules=["f64-leak"],
+    )
+    assert findings == []
+
+
+# --- bf16-promotion-drift ----------------------------------------------------
+
+
+def test_bf16_drift_golden():
+    @jax.jit
+    def f32_contraction(a, b):
+        return a @ b  # f32 dot in a program that declares bf16
+
+    a = np.ones((8, 8), np.float32)
+    findings, _ = run_rules(
+        BuiltProgram(
+            fn=f32_contraction, args=(a, a), declared_dtype="bfloat16"
+        ),
+        rules=["bf16-promotion-drift"],
+    )
+    assert [f.rule for f in findings] == ["bf16-promotion-drift"]
+    assert findings[0].detail["f32_contractions"] == 1
+
+
+def test_bf16_drift_clean_when_contractions_are_bf16():
+    @jax.jit
+    def bf16_contraction(a, b):
+        return (a @ b).astype(jnp.float32)  # f32 ELEMENTWISE cast is fine
+
+    a = np.ones((8, 8), np.float16).astype(jnp.bfloat16)
+    findings, _ = run_rules(
+        BuiltProgram(
+            fn=bf16_contraction, args=(a, a), declared_dtype="bfloat16"
+        ),
+        rules=["bf16-promotion-drift"],
+    )
+    assert findings == []
+
+
+def test_bf16_drift_ignores_undeclared_programs():
+    @jax.jit
+    def f32_contraction(a, b):
+        return a @ b
+
+    a = np.ones((8, 8), np.float32)
+    findings, _ = run_rules(
+        BuiltProgram(fn=f32_contraction, args=(a, a)),  # no declared dtype
+        rules=["bf16-promotion-drift"],
+    )
+    assert findings == []
+
+
+# --- host-callback-in-jit ----------------------------------------------------
+
+
+def test_host_callback_golden():
+    @jax.jit
+    def chatty(x):
+        jax.debug.print("x has mean {m}", m=x.mean())
+        return x + 1
+
+    findings, _ = run_rules(
+        BuiltProgram(fn=chatty, args=(np.ones(4, np.float32),)),
+        rules=["host-callback-in-jit"],
+    )
+    assert [f.rule for f in findings] == ["host-callback-in-jit"]
+    assert findings[0].severity == "error"
+    assert "callback" in findings[0].detail["primitive"]
+
+
+def test_host_callback_clean():
+    @jax.jit
+    def quiet(x):
+        return x + 1
+
+    findings, _ = run_rules(
+        BuiltProgram(fn=quiet, args=(np.ones(4, np.float32),)),
+        rules=["host-callback-in-jit"],
+    )
+    assert findings == []
+
+
+# --- missing-donation --------------------------------------------------------
+
+
+def _carry_fn(donate):
+    def step(state, x):
+        return jax.tree_util.tree_map(lambda s: s + x, state), x * 2
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def _carry_args():
+    state = {"w": np.zeros((128, 128), np.float32),
+             "b": np.zeros((128,), np.float32)}
+    return (state, np.float32(1.0))
+
+
+def test_missing_donation_golden():
+    findings, _ = run_rules(
+        BuiltProgram(
+            fn=_carry_fn(donate=False),
+            args=_carry_args(),
+            donate_expect={0: "carried state"},
+        ),
+        rules=["missing-donation"],
+    )
+    assert [f.rule for f in findings] == ["missing-donation"]
+    # wasted bytes = the whole undonated carry: 128*128*4 + 128*4
+    assert findings[0].detail["wasted_bytes"] == 128 * 128 * 4 + 128 * 4
+    assert findings[0].detail["undonated_leaves"] == 2
+
+
+def test_missing_donation_clean_when_donated():
+    findings, _ = run_rules(
+        BuiltProgram(
+            fn=_carry_fn(donate=True),
+            args=_carry_args(),
+            donate_expect={0: "carried state"},
+        ),
+        rules=["missing-donation"],
+    )
+    assert findings == []
+
+
+# --- oversized-constant ------------------------------------------------------
+
+
+def test_oversized_constant_golden():
+    baked = jnp.asarray(np.ones((600, 600), np.float32))  # 1.44 MB captured
+
+    @jax.jit
+    def apply(x):
+        return x @ baked
+
+    findings, _ = run_rules(
+        BuiltProgram(fn=apply, args=(np.ones((4, 600), np.float32),)),
+        rules=["oversized-constant"],
+    )
+    assert [f.rule for f in findings] == ["oversized-constant"]
+    assert findings[0].detail["bytes"] == 600 * 600 * 4
+
+
+def test_oversized_constant_clean_when_passed_as_arg():
+    @jax.jit
+    def apply(x, w):
+        return x @ w
+
+    findings, _ = run_rules(
+        BuiltProgram(
+            fn=apply,
+            args=(np.ones((4, 600), np.float32),
+                  np.ones((600, 600), np.float32)),
+        ),
+        rules=["oversized-constant"],
+    )
+    assert findings == []
+
+
+# --- flop-accounting-drift ---------------------------------------------------
+
+
+def test_flop_walk_counts_dot_general_exactly():
+    @jax.jit
+    def mm(a, b):
+        return a @ b
+
+    tp = trace_program(
+        "syn/mm",
+        BuiltProgram(
+            fn=mm,
+            args=(np.ones((8, 16), np.float32), np.ones((16, 8), np.float32)),
+        ),
+    )
+    assert jaxpr_flops(tp.jaxpr) == 2 * 8 * 8 * 16
+
+
+def test_flop_drift_golden_and_clean():
+    @jax.jit
+    def mm(a, b):
+        return a @ b
+
+    args = (np.ones((8, 16), np.float32), np.ones((16, 8), np.float32))
+    exact = 2 * 8 * 8 * 16
+
+    findings, _ = run_rules(
+        BuiltProgram(fn=mm, args=args, expected_flops=exact * 2),
+        rules=["flop-accounting-drift"],
+    )
+    assert [f.rule for f in findings] == ["flop-accounting-drift"]
+    assert findings[0].detail["walked_flops"] == exact
+
+    findings, _ = run_rules(
+        BuiltProgram(fn=mm, args=args, expected_flops=exact),
+        rules=["flop-accounting-drift"],
+    )
+    assert findings == []
+
+
+# --- waivers (the audit's suppression mechanism) -----------------------------
+
+
+def test_waiver_with_reason_moves_finding_aside():
+    @jax.jit
+    def chatty(x):
+        jax.debug.print("{x}", x=x)
+        return x
+
+    findings, waived = run_rules(
+        BuiltProgram(fn=chatty, args=(np.ones(2, np.float32),)),
+        waivers={"host-callback-in-jit": "debug-only program"},
+        rules=["host-callback-in-jit"],
+    )
+    assert findings == []
+    assert [f.rule for f in waived] == ["host-callback-in-jit"]
+
+
+def test_waiver_without_reason_is_an_error():
+    @jax.jit
+    def quiet(x):
+        return x
+
+    findings, _ = run_rules(
+        BuiltProgram(fn=quiet, args=(np.ones(2, np.float32),)),
+        waivers={"host-callback-in-jit": "  "},
+    )
+    assert any(f.rule == "bad-waiver" and f.severity == "error"
+               for f in findings)
+
+
+# --- the rule catalog --------------------------------------------------------
+
+
+def test_jaxpr_rule_catalog():
+    assert len(JAXPR_RULES) >= 6
+    for r in JAXPR_RULES.values():
+        assert r.doc.strip(), f"jaxpr rule {r.rule_id} has no catalog doc"
+    meta = rules_meta()
+    assert "bad-waiver" in meta and "audit-trace-failure" in meta
+
+
+def test_unjitted_program_is_rejected():
+    def plain(x):
+        return x + 1
+
+    with pytest.raises(ValueError, match="pjit"):
+        trace_program(
+            "syn/plain",
+            BuiltProgram(fn=plain, args=(np.ones(2, np.float32),)),
+        )
+
+
+# --- end-to-end over the REAL entry programs ---------------------------------
+
+
+def test_real_programs_zero_unsuppressed_findings():
+    """The acceptance gate: >= 5 distinct real entry programs trace clean.
+
+    This is what `scripts/audit.py` (and CI) runs — dense train, cached
+    train, sparse train, a serve bucket program, and the eval match fn
+    all audited with zero unsuppressed findings.
+    """
+    result = audit()
+    assert result.all_findings == [], [
+        f.format() for f in result.all_findings
+    ]
+    names = {r["program"] for r in result.reports}
+    assert {
+        "train/dense", "train/cached", "train/sparse",
+        "serve/bucket", "eval/match",
+    } <= names
+    assert len(names) >= 5
+
+
+def test_real_train_programs_flop_walk_matches_accounting():
+    """The walk and the closed form agree on every f32 train program —
+    the regression tripwire for the MFU numerator."""
+    result = audit(["train/dense", "train/cached", "train/sparse"])
+    assert result.all_findings == []
+    for r in result.reports:
+        expected = r["flops_expected"]
+        assert expected, r
+        drift = abs(r["flops_walked"] - expected) / expected
+        assert drift < 1e-9, (r["program"], r["flops_walked"], expected)
+
+
+def test_real_programs_donate_their_carried_buffers():
+    result = audit(["train/dense", "serve/bucket"])
+    by_name = {r["program"]: r for r in result.reports}
+    # train: the carried state dominates the input bytes and is donated
+    train = by_name["train/dense"]
+    assert train["bytes_donated"] > 0
+    # serve: the padded batch (both images) is donated, params are not
+    serve = by_name["serve/bucket"]
+    assert serve["bytes_donated"] == 2 * 2 * 64 * 64 * 3 * 4
+    assert serve["bytes_donated"] < serve["bytes_in"]
+
+
+def test_report_table_renders():
+    result = audit(["eval/match"])
+    table = format_report_table(result.reports)
+    assert "eval/match" in table and "flops(walk)" in table
+
+
+def test_program_registry_waiver_reasons_nonempty():
+    for spec in PROGRAMS.values():
+        for rule_id, reason in spec.waivers.items():
+            assert reason.strip(), (spec.name, rule_id)
+
+
+# --- shared findings schema: JSON + SARIF ------------------------------------
+
+
+def test_sarif_document_shape():
+    fs = [
+        Finding("jaxpr:train/dense", 1, 0, "missing-donation", "warning",
+                "arg 0 not donated", {"wasted_bytes": 5}),
+        Finding("ncnet_tpu/train/loop.py", 12, 4, "host-sync-in-jit",
+                "warning", "sync"),
+    ]
+    doc = json.loads(format_sarif(fs, "audit", rules_meta()))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "audit"
+    ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert "missing-donation" in ids
+    first = run["results"][0]
+    assert first["ruleId"] == "missing-donation"
+    assert first["properties"]["wasted_bytes"] == 5
+    loc = first["locations"][0]["physicalLocation"]
+    assert loc["region"]["startLine"] == 1
+    assert loc["artifactLocation"]["uri"] == "jaxpr:train/dense"
+
+
+def test_audit_cli_json_and_gate(capsys):
+    import sys
+
+    sys.path.insert(0, "scripts")
+    try:
+        from audit import main
+    finally:
+        sys.path.pop(0)
+
+    assert main(["--format", "json", "--programs", "eval/match"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["tool"] == "audit"
+    assert payload["schema_version"] == 1
+    assert payload["count"] == 0
+
+
+def test_nclint_sarif_output(tmp_path, capsys):
+    from ncnet_tpu.analysis.cli import main
+
+    bad = tmp_path / "mod.py"
+    bad.write_text("def f(xs=[]):\n    return xs\n")
+    assert main([str(bad), "--format", "sarif"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["runs"][0]["tool"]["driver"]["name"] == "nclint"
+    assert doc["runs"][0]["results"][0]["ruleId"] == "mutable-default-arg"
