@@ -25,9 +25,19 @@ one percentile implementation — the PERF.md round-10 numbers. CPU proxy discip
 and amortization mechanics are platform-independent; absolute ms are
 not.
 
+SLO mode (PR 10): `--deadline-ms D` submits every request with a
+deadline — sheds and in-pipeline deadline drops become tallied
+outcomes and the JSON line grows `goodput_pairs_s` (requests that met
+their SLO per second) next to raw throughput; `--degrade K` pre-warms
+the `nc_topk=K` band program as a DEGRADED variant the hysteresis
+controller may flip dispatch to under queue pressure (shrink
+`--queue-limit` to provoke it), reporting `degraded_batches` /
+`degrade_flips`.
+
 Usage:
   python benchmarks/micro_serve.py [--pairs 32] [--image-size 96]
       [--concurrency 8] [--max-batch 8] [--nc-topk 0]
+      [--deadline-ms 0] [--degrade -1] [--queue-limit 64]
 """
 
 import argparse
@@ -77,6 +87,19 @@ def main():
     p.add_argument("--max-wait-ms", type=float, default=60.0)
     p.add_argument("--host-workers", type=int, default=2)
     p.add_argument("--nc-topk", type=int, default=0)
+    p.add_argument("--queue-limit", type=int, default=64,
+                   help="bounded submit queue; shrink it to raise the "
+                        "queue-pressure fraction the degradation "
+                        "controller sees")
+    p.add_argument("--deadline-ms", type=float, default=0.0,
+                   help="per-request SLO for the served pass (0 off): "
+                        "sheds + in-pipeline deadline drops are tallied "
+                        "instead of counted as served throughput")
+    p.add_argument("--degrade", type=int, default=-1,
+                   help="nc_topk of the pre-warmed DEGRADED program the "
+                        "hysteresis controller may flip to under queue "
+                        "pressure (-1 off); flips/degraded batches are "
+                        "reported")
     args = p.parse_args()
 
     import jax
@@ -89,6 +112,7 @@ def main():
     from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
     from ncnet_tpu.serve import (
         BucketSpec,
+        RequestShed,
         ServeEngine,
         make_serve_match_step,
         payload_spec,
@@ -150,6 +174,13 @@ def main():
         seq_wall = time.perf_counter() - t0
 
         # --- batched serving ---------------------------------------------
+        slo = args.deadline_ms > 0 or args.degrade >= 0
+        degraded_fn = (
+            make_serve_match_step(config.replace(nc_topk=args.degrade))
+            if args.degrade >= 0
+            else None
+        )
+        deadline_s = args.deadline_ms / 1e3 if args.deadline_ms > 0 else None
         with ServeEngine(
             apply_fn,
             params,
@@ -157,6 +188,8 @@ def main():
             max_wait=args.max_wait_ms / 1e3,
             host_workers=args.host_workers,
             prep_fn=prep,
+            queue_limit=args.queue_limit,
+            degraded_apply_fn=degraded_fn,
         ) as engine:
             seen = {}
             for pair in requests:
@@ -175,7 +208,9 @@ def main():
                         i = next(it, None)
                     if i is None:
                         return
-                    slots[i] = engine.submit(requests[i])
+                    slots[i] = engine.submit(
+                        requests[i], deadline_s=deadline_s
+                    )
 
             t0 = time.perf_counter()
             threads = [
@@ -186,8 +221,15 @@ def main():
                 t.start()
             for t in threads:
                 t.join()
+            completed = 0
             for fut in slots:
-                fut.result()
+                try:
+                    fut.result()
+                    completed += 1
+                except RequestShed:
+                    # SLO mode: shed / deadline-dropped requests are a
+                    # tallied outcome, not a benchmark failure
+                    pass
             serve_wall = time.perf_counter() - t0
             stats = engine.report()
             # the engine's OWN latency histogram is the percentile source
@@ -212,6 +254,19 @@ def main():
         "serve_p99_ms": round(pct["p99"] * 1e3, 1),
         "seq_p50_ms": round(seq_hist.percentiles()["p50"] * 1e3, 1),
     }
+    if slo:
+        # SLO mode: sheds are a tallied outcome, so report goodput
+        # (requests that met their deadline) alongside raw throughput
+        out.update({
+            "deadline_ms": args.deadline_ms,
+            "degrade_topk": args.degrade,
+            "completed": completed,
+            "goodput_pairs_s": round(completed / serve_wall, 2),
+            "shed": stats["shed"],
+            "deadline_exceeded": stats["deadline_exceeded"],
+            "degraded_batches": stats["degraded_batches"],
+            "degrade_flips": stats["degrade_flips"],
+        })
     print(json.dumps(out))
 
 

@@ -35,6 +35,11 @@ arXiv:2004.10566, the low-precision normalization fragility):
                             negative — use ``time.perf_counter`` (the
                             telemetry tracer's contract); wall time is for
                             TIMESTAMP fields only
+  swallowed-exception       a broad ``except`` (bare/Exception/BaseException)
+                            in library code that neither re-raises nor uses
+                            the caught exception: the failure vanishes —
+                            the anti-pattern the serving engine's typed
+                            failures + stage supervision exist to prevent
 
 All rules are intentionally conservative: a finding should mean something;
 the escape hatch for justified exceptions is the mandatory-reason inline
@@ -978,3 +983,76 @@ def wall_clock_timing(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
                     "time with time.perf_counter(), keep time.time() for "
                     "timestamp fields only"
                 )
+
+
+# --- swallowed-exception ----------------------------------------------------
+
+
+_BROAD_EXC_NAMES = ("Exception", "BaseException")
+
+
+def _is_broad_handler(ctx: ModuleContext, handler: ast.ExceptHandler) -> bool:
+    """Bare ``except:``, ``except Exception``/``BaseException``, or a tuple
+    containing either — the handlers wide enough to eat bugs."""
+    if handler.type is None:
+        return True
+    types = (
+        handler.type.elts
+        if isinstance(handler.type, ast.Tuple)
+        else [handler.type]
+    )
+    for t in types:
+        name = ctx.canonical(t) or ""
+        if name in _BROAD_EXC_NAMES or name.startswith("builtins."):
+            if name.rsplit(".", 1)[-1] in _BROAD_EXC_NAMES:
+                return True
+    return False
+
+
+@rule(
+    "swallowed-exception",
+    "warning",
+    doc="A broad `except` (bare / Exception / BaseException) that neither "
+        "re-raises nor uses the caught exception: the failure vanishes — "
+        "no typed error on a future, no log line, no counter — which is "
+        "exactly how a resilience path rots into decoration (the serving "
+        "engine's stage supervisors exist because swallowed worker "
+        "exceptions silently shrink the pool). Handle it (route the "
+        "exception somewhere: a typed failure, a log, a metric), narrow "
+        "the except, or re-raise; a deliberate capability probe or "
+        "best-effort fallback gets a reasoned suppression.",
+)
+def swallowed_exception(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    if ctx.is_test:
+        return  # tests legitimately assert "does not raise"
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad_handler(ctx, node):
+            continue
+        handled = False
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Raise):
+                    handled = True  # re-raises (possibly conditionally)
+                elif (
+                    node.name is not None
+                    and isinstance(sub, ast.Name)
+                    and sub.id == node.name
+                ):
+                    handled = True  # the exception is routed somewhere
+            if handled:
+                break
+        if not handled:
+            what = (
+                "bare except" if node.type is None
+                else f"except {ast.unparse(node.type)}"
+            )
+            yield node, (
+                f"{what} swallows the exception: nothing re-raises and "
+                "the caught error is never used, so the failure "
+                "disappears without a trace; narrow the handler, "
+                "re-raise, or route the exception (typed error / log / "
+                "metric) — deliberate best-effort probes need a "
+                "reasoned suppression"
+            )

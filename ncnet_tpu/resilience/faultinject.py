@@ -25,6 +25,18 @@ the failures the recovery paths claim to survive:
                                 (`ncnet_tpu.serve`): fires on a worker thread
                                 before decode/resize, so delay/crash exercises
                                 slow or failed requests without stalling others
+  ``serve.worker.crash``        serving prep worker, OUTSIDE the per-request
+                                handler: an injected crash is a STAGE crash —
+                                the supervisor must fail only the in-flight
+                                request (typed `StageFailure`) and restart
+  ``serve.dispatch.hang``       serving dispatch, after the in-flight batch is
+                                registered and before the device call:
+                                ``delay:<s>`` wedges the thread (the watchdog
+                                hang drill), ``crash`` is a dispatch-stage crash
+  ``serve.readout.delay``       serving readout, after a batch is popped:
+                                ``delay:<s>`` models a slow D2H/convert (the
+                                readout-deadline drill), ``crash`` a readout-
+                                stage crash
   ``telemetry.write``           telemetry exporters (`ncnet_tpu.telemetry`):
                                 before each JSONL event-log flush, and mid-write
                                 of the ``.prom`` snapshot temp file — a crash

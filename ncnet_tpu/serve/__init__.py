@@ -18,6 +18,14 @@ same padded array, and a lone request (padded to batch 1) is bitwise the
 per-pair pipeline. Across different padded batch sizes, results agree to
 the few-ulp float associativity of XLA's batch-size-dependent codegen —
 the only permitted difference (tests/test_serve.py pins all three).
+
+SLO + resilience layer (:mod:`ncnet_tpu.serve.resilience`): per-request
+deadlines with admission-control shedding (typed `RequestShed` /
+`DeadlineExceeded` / `AdmissionRejected` outcomes), hysteresis-controlled
+degradation to a pre-warmed sparse program under overload, supervised
+stage restarts with a dispatch-hang watchdog (`StageFailure`), and
+deadline-bounded graceful drain (`drain_on_preemption` + the SIGTERM
+`PreemptionGuard`).
 """
 
 from ncnet_tpu.serve.batcher import MicroBatch, MicroBatcher, default_batch_sizes
@@ -29,14 +37,35 @@ from ncnet_tpu.serve.buckets import (
     request_buckets,
 )
 from ncnet_tpu.serve.engine import ServeEngine, make_serve_match_step, payload_spec
+from ncnet_tpu.serve.resilience import (
+    AdmissionRejected,
+    DeadlineExceeded,
+    HysteresisController,
+    LatencyEstimator,
+    RequestShed,
+    ServeResilienceError,
+    StageFailure,
+    Watchdog,
+    drain_on_preemption,
+    run_supervised,
+)
 
 __all__ = [
-    "SCALE_FACTOR",
+    "AdmissionRejected",
     "BucketSpec",
+    "DeadlineExceeded",
+    "HysteresisController",
+    "LatencyEstimator",
     "MicroBatch",
     "MicroBatcher",
+    "RequestShed",
+    "SCALE_FACTOR",
     "ServeEngine",
+    "ServeResilienceError",
+    "StageFailure",
+    "Watchdog",
     "default_batch_sizes",
+    "drain_on_preemption",
     "make_serve_match_step",
     "pair_bucket",
     "payload_spec",

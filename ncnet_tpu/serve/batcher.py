@@ -59,15 +59,19 @@ def pad_size(n, batch_sizes):
 
 class Request:
     """One queued request: a bucket key, named per-sample arrays, and the
-    future its result resolves. ``t_submit`` feeds latency accounting."""
+    future its result resolves. ``t_submit`` feeds latency accounting;
+    ``deadline`` (absolute, on the engine clock, None = no SLO) lets the
+    pipeline drop the request at any stage once it can no longer be
+    served in time (engine's deadline contract, PR 10)."""
 
-    __slots__ = ("key", "payload", "future", "t_submit")
+    __slots__ = ("key", "payload", "future", "t_submit", "deadline")
 
-    def __init__(self, key, payload, future, t_submit):
+    def __init__(self, key, payload, future, t_submit, deadline=None):
         self.key = key
         self.payload = payload
         self.future = future
         self.t_submit = t_submit
+        self.deadline = deadline
 
 
 @dataclasses.dataclass
@@ -90,7 +94,12 @@ class MicroBatcher:
     """Per-key request coalescing under a deadline and a cap.
 
     Thread-safe; all methods are non-blocking. ``clock`` must be a
-    monotonic ``() -> float`` (seconds); tests pass a fake.
+    monotonic ``() -> float`` (seconds); tests pass a fake. The batcher
+    TOLERATES a clock that violates the contract and jumps backwards
+    (e.g. a buggy injected clock): no group is ever lost or flushed
+    early — deadlines simply stretch until the clock passes the add
+    time again, and `add`'s cap flush and `drain` are clock-independent
+    (pinned in tests/test_serve_resilience.py).
     """
 
     def __init__(

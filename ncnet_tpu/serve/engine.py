@@ -4,14 +4,14 @@ Three stages, each on its own thread(s), bounded queues between them
 (double-buffered in the style of `train.loop._prefetch_device_batches`):
 
 1. **host prep** — ``host_workers`` threads pop raw requests from a
-   BOUNDED submit queue (backpressure: `submit` blocks or raises
-   ``queue.Full``), hit the ``serve.request`` fault point
-   (`resilience.faultinject` — tests inject slow/failed requests here
-   without stalling the pipeline), run ``prep_fn`` (decode/resize/
-   normalize, or a feature-store lookup) under the data loader's
-   per-attempt retry + exponential backoff (``prep_retries`` — the same
-   `data.loader.retry_call` the training loaders use for transient
-   I/O), and feed the micro-batcher;
+   BOUNDED submit queue (backpressure: `submit` blocks or raises a typed
+   `AdmissionRejected`, a ``queue.Full`` subclass), hit the
+   ``serve.request`` fault point (`resilience.faultinject` — tests
+   inject slow/failed requests here without stalling the pipeline), run
+   ``prep_fn`` (decode/resize/normalize, or a feature-store lookup)
+   under the data loader's per-attempt retry + exponential backoff
+   (``prep_retries`` — the same `data.loader.retry_call` the training
+   loaders use for transient I/O), and feed the micro-batcher;
 2. **device dispatch** — one thread drives `MicroBatcher` (cap +
    deadline flushes), stacks each flushed group into a padded
    fixed-shape batch, runs the AOT-compiled executable for
@@ -35,12 +35,44 @@ real compile (the counting-jit assertion in tests/test_serve.py), and
 any compile triggered by a LIVE request after warmup is reported as
 ``recompiles_after_warmup`` (the number `scripts/serve.py` must show as
 zero).
+
+SLO + resilience layer (PR 10, `serve.resilience`):
+
+* **Deadlines & shedding** — ``submit(..., deadline_s=)`` stamps an
+  absolute deadline on the engine clock. Admission control sheds
+  requests whose deadline would expire before the estimated completion
+  (`LatencyEstimator` EWMA of per-bucket batch latency, fed at readout)
+  with a typed `RequestShed` on the returned future — no queue slot is
+  occupied. Requests whose deadline expires IN pipeline are dropped at
+  the prep / dispatch / readout stage with `DeadlineExceeded` rather
+  than wasting a device slot.
+* **Overload degradation** — with a ``degraded_apply_fn`` (the
+  pre-warmed `nc_topk` band program), a `HysteresisController` watches
+  the queued-work fraction and flips per-bucket dispatch to the cheaper
+  program under sustained pressure, back when it clears. Both variants
+  are AOT-compiled at `warmup()`; flip events, degraded-batch counts,
+  and the mode/pressure gauges all export through the registry.
+* **Supervision** — every stage loop runs under `run_supervised`: a
+  stage crash fails ONLY its in-flight request(s) with a typed
+  `StageFailure` and the stage restarts with the warm compile cache
+  intact (``recompiles_after_warmup`` stays 0). A hung dispatch (a
+  Python thread wedged in a device call cannot be killed) is detected
+  by a heartbeat `Watchdog` (``hang_timeout``): the in-flight batch
+  fails typed, the dispatch GENERATION is bumped so the wedged thread
+  discards its work when it wakes, and a fresh dispatch thread takes
+  over.
+* **Graceful drain** — `shutdown(timeout=)` / `drain()` stop admission
+  and drain the pipeline under a deadline; every accepted future
+  resolves with a result or a typed shed. `close()` is
+  ``shutdown(None)`` (blocking, the pre-PR-10 semantics);
+  `resilience.drain_on_preemption` ties this to the SIGTERM
+  `PreemptionGuard`.
 """
 
 import queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 
 import numpy as np
 
@@ -48,7 +80,23 @@ import jax
 
 from ncnet_tpu.data.loader import retry_call
 from ncnet_tpu.resilience import faultinject
-from ncnet_tpu.serve.batcher import MicroBatcher, Request, default_batch_sizes
+from ncnet_tpu.serve.batcher import (
+    MicroBatch,
+    MicroBatcher,
+    Request,
+    default_batch_sizes,
+    pad_size,
+)
+from ncnet_tpu.serve.resilience import (
+    AdmissionRejected,
+    DeadlineExceeded,
+    HysteresisController,
+    LatencyEstimator,
+    RequestShed,
+    StageFailure,
+    Watchdog,
+    run_supervised,
+)
 from ncnet_tpu.telemetry import trace
 from ncnet_tpu.telemetry.registry import (
     DEFAULT_LATENCY_BUCKETS,
@@ -89,6 +137,9 @@ def make_serve_match_step(config, softmax=True, from_features=False):
     fused into one output array. The direction concat stays inside the
     compiled program; the batch axis is moved first so readout slices
     one ``[5, n]`` block per request.
+
+    The degraded serving program is this same constructor at a sparse
+    geometry: ``make_serve_match_step(replace(config, nc_topk=K))``.
     """
     import jax.numpy as jnp
 
@@ -122,8 +173,26 @@ class ServeEngine:
     vs the same program unpadded; vs a different-batch-size program the
     results agree to XLA codegen ulps, tests/test_serve.py).
 
+    Resilience knobs (all optional — defaults preserve the PR 6
+    behavior):
+
+    * ``degraded_apply_fn`` — the cheaper program (same signature as
+      ``apply_fn``) the `HysteresisController` flips dispatch to under
+      sustained queue pressure; pass ``degrade_controller=`` to tune the
+      thresholds. Both variants compile at `warmup()`.
+    * ``hang_timeout`` — enable the dispatch heartbeat `Watchdog`. Must
+      exceed the worst-case single-batch latency INCLUDING any live
+      compile of an unwarmed bucket, or a legitimately long device call
+      reads as a hang; None (default) disables it.
+    * ``deadline_margin`` — safety factor on the EWMA latency estimate
+      admission control sheds against.
+    * ``clock`` — injectable monotonic clock shared with the batcher
+      (tests pass a fake).
+
     Use as a context manager; `close` drains in-flight work, resolves
-    every accepted future, and joins all threads.
+    every accepted future, and joins all threads; `shutdown(timeout=)`
+    is the bounded-drain variant (leftover futures resolve with a typed
+    `RequestShed`).
     """
 
     def __init__(
@@ -142,6 +211,12 @@ class ServeEngine:
         readout_depth=2,
         compile_cache_dir=None,
         registry=None,
+        degraded_apply_fn=None,
+        degrade_controller=None,
+        deadline_margin=1.0,
+        hang_timeout=None,
+        estimator=None,
+        clock=time.monotonic,
     ):
         if compile_cache_dir is not None:
             from ncnet_tpu.utils.compile_cache import enable_compile_cache
@@ -151,6 +226,9 @@ class ServeEngine:
         self._prep_fn = prep_fn
         self._prep_retries = prep_retries
         self._retry_backoff = retry_backoff
+        self._clock = clock
+        self._queue_limit = queue_limit
+        self._deadline_margin = deadline_margin
         self.batch_sizes = (
             tuple(sorted(batch_sizes))
             if batch_sizes is not None
@@ -158,13 +236,18 @@ class ServeEngine:
         )
         self._batcher = MicroBatcher(
             max_batch=max_batch, max_wait=max_wait,
-            batch_sizes=self.batch_sizes,
+            batch_sizes=self.batch_sizes, clock=clock,
+        )
+        self.estimator = (
+            estimator if estimator is not None else LatencyEstimator()
         )
 
-        # one jit wrapper per engine; its cache is NEVER hit in steady
-        # state (serving calls the AOT executables below), it exists to
-        # lower/compile and to count traces: the increment is a Python
-        # side effect that runs only when JAX actually retraces
+        # one jit wrapper per engine (two with a degraded program); the
+        # jit caches are NEVER hit in steady state (serving calls the
+        # AOT executables below) — they exist to lower/compile and to
+        # count traces: the increment is a Python side effect that runs
+        # only when JAX actually retraces. Both wrappers share ONE
+        # counter, so `compile_count` covers dense + degraded programs.
         self._trace_count = 0
 
         def _counted_apply(p, batch):
@@ -172,7 +255,26 @@ class ServeEngine:
             return apply_fn(p, batch)
 
         self._jit = jax.jit(_counted_apply, donate_argnums=SERVE_DONATE_ARGNUMS)
-        self._compiled = {}  # (bucket key, padded size) -> executable
+        self._jit_degraded = None
+        if degraded_apply_fn is not None:
+
+            def _counted_degraded(p, batch):
+                self._trace_count += 1
+                return degraded_apply_fn(p, batch)
+
+            self._jit_degraded = jax.jit(
+                _counted_degraded, donate_argnums=SERVE_DONATE_ARGNUMS
+            )
+        self.controller = (
+            degrade_controller
+            if degrade_controller is not None
+            else (
+                HysteresisController()
+                if degraded_apply_fn is not None
+                else None
+            )
+        )
+        self._compiled = {}  # (bucket key, padded size, degraded) -> exe
         self._compile_lock = threading.Lock()
         self._warm = False
 
@@ -180,7 +282,16 @@ class ServeEngine:
         self._batch_q = queue.Queue()
         self._readout_q = queue.Queue(maxsize=readout_depth)
         self._closed = False
+        self._close_lock = threading.Lock()
+        self._drained = threading.Event()
         self._stop_dispatch = threading.Event()
+
+        # every accepted, unresolved future — the drain contract's
+        # ledger: whatever is still here when the drain deadline expires
+        # is failed with a typed shed, so 100% of accepted futures
+        # resolve before shutdown returns
+        self._pending = set()
+        self._pending_lock = threading.Lock()
 
         # Engine stats live in a telemetry metrics registry; `report()`
         # is a VIEW over it. Private per engine by default (co-resident
@@ -200,6 +311,18 @@ class ServeEngine:
             "serve_requests_failed_total",
             "requests resolved with an exception",
         )
+        self._m_shed = m.counter(
+            "serve_requests_shed_total",
+            "requests shed by admission control or an expired drain",
+        )
+        self._m_deadline = m.counter(
+            "serve_deadline_exceeded_total",
+            "accepted requests dropped in-pipeline on an expired deadline",
+        )
+        self._m_rejected = m.counter(
+            "serve_admission_rejected_total",
+            "submits refused on a full queue (AdmissionRejected)",
+        )
         self._m_batches = m.counter(
             "serve_batches_total", "device batches dispatched"
         )
@@ -214,6 +337,27 @@ class ServeEngine:
             "serve_recompiles_after_warmup_total",
             "live-request compiles after warmup (must stay 0)",
         )
+        self._m_degraded_batches = m.counter(
+            "serve_batches_degraded_total",
+            "batches served by the degraded program",
+        )
+        self._m_flips = m.counter(
+            "serve_degrade_flips_total",
+            "degradation controller mode changes (either direction)",
+        )
+        self._m_hangs = m.counter(
+            "serve_dispatch_hangs_total",
+            "dispatch heartbeat timeouts detected by the watchdog",
+        )
+        self._m_prep_restarts = m.counter(
+            "serve_prep_restarts_total", "prep worker stage restarts"
+        )
+        self._m_dispatch_restarts = m.counter(
+            "serve_dispatch_restarts_total", "dispatch stage restarts"
+        )
+        self._m_readout_restarts = m.counter(
+            "serve_readout_restarts_total", "readout stage restarts"
+        )
         self._m_latency = m.histogram(
             "serve_request_latency_seconds",
             "submit-to-result latency",
@@ -224,8 +368,8 @@ class ServeEngine:
             "real rows per dispatched batch",
             buckets=tuple(float(b) for b in self.batch_sizes),
         )
-        # Sampled gauges: the truth lives in the queue / the counters,
-        # the gauges read it at scrape time.
+        # Sampled gauges: the truth lives in the queue / the counters /
+        # the controller, the gauges read it at scrape time.
         m.gauge(
             "serve_submit_queue_depth",
             "requests waiting in the bounded submit queue",
@@ -234,23 +378,50 @@ class ServeEngine:
             "serve_mean_occupancy",
             "cumulative real/padded row ratio across served batches",
         ).set_fn(self._mean_occupancy)
+        m.gauge(
+            "serve_degraded_mode",
+            "1 when dispatch is flipped to the degraded program",
+        ).set_fn(lambda: 1.0 if self._degraded_now() else 0.0)
+        m.gauge(
+            "serve_pressure",
+            "queued-work fraction the degradation controller last saw",
+        ).set_fn(
+            lambda: (
+                self.controller.last_pressure
+                if self.controller is not None
+                else 0.0
+            )
+        )
 
         self._workers = [
             threading.Thread(
-                target=self._prep_loop, name=f"serve-prep-{i}", daemon=True
+                target=self._prep_worker, name=f"serve-prep-{i}", daemon=True
             )
             for i in range(host_workers)
         ]
-        self._dispatcher = threading.Thread(
-            target=self._dispatch_loop, name="serve-dispatch", daemon=True
-        )
+        # dispatch runs under a GENERATION: hang recovery bumps the
+        # generation and starts a fresh thread; the wedged one discards
+        # its work when it wakes (a Python thread cannot be killed)
+        self._dispatch_gen = 0
+        self._gen_lock = threading.Lock()
+        self._inflight_dispatch = {}  # gen -> the batch on the device
+        self._dispatch_beat = clock()
         self._reader = threading.Thread(
-            target=self._readout_loop, name="serve-readout", daemon=True
+            target=self._readout_worker, name="serve-readout", daemon=True
         )
         for t in self._workers:
             t.start()
-        self._dispatcher.start()
+        self._start_dispatcher()
         self._reader.start()
+        self._watchdog = None
+        if hang_timeout is not None:
+            self._watchdog = Watchdog(
+                hang_timeout,
+                beat_fn=lambda: self._dispatch_beat,
+                busy_fn=lambda: bool(self._inflight_dispatch),
+                on_hang=self._on_dispatch_hang,
+                clock=clock,
+            ).start()
 
     # ------------------------------------------------------------------
     # compile management
@@ -262,17 +433,23 @@ class ServeEngine:
             for name, (shape, dtype) in pspec.items()
         }
 
-    def _executable(self, key, bs, pspec, live):
-        ck = (key, bs)
+    def _executable(self, key, bs, pspec, live, degraded=False):
+        ck = (key, bs, degraded)
         exe = self._compiled.get(ck)
         if exe is not None:
             return exe
+        jit = self._jit_degraded if degraded else self._jit
+        if jit is None:
+            raise ValueError(
+                "degraded dispatch requested but the engine has no "
+                "degraded_apply_fn"
+            )
         with self._compile_lock:
             exe = self._compiled.get(ck)
             if exe is None:
                 if live and self._warm:
                     self._m_recompiles.inc()
-                exe = self._jit.lower(
+                exe = jit.lower(
                     self._params, self._specs(key, bs, pspec)
                 ).compile()
                 self._compiled[ck] = exe
@@ -283,15 +460,21 @@ class ServeEngine:
 
         ``bucket_specs``: iterable of ``(key, per-sample spec)`` where the
         spec is `payload_spec`-shaped (``{name: (shape, dtype)}``). Each
-        key is compiled at EVERY allowed padded batch size, so a warmed
-        engine serves any traffic mix over those buckets with zero
-        compiles. Incremental: may be called again for newly-discovered
-        buckets; warmup compiles are never counted as recompiles. Returns
-        the number of compiled programs now cached.
+        key is compiled at EVERY allowed padded batch size — and, when a
+        ``degraded_apply_fn`` is configured, in BOTH program variants —
+        so a warmed engine serves any traffic mix over those buckets with
+        zero compiles even across degradation flips. Incremental: may be
+        called again for newly-discovered buckets; warmup compiles are
+        never counted as recompiles. Returns the number of compiled
+        programs now cached.
         """
         for key, pspec in bucket_specs:
             for bs in self.batch_sizes:
                 self._executable(key, bs, pspec, live=False)
+                if self._jit_degraded is not None:
+                    self._executable(
+                        key, bs, pspec, live=False, degraded=True
+                    )
         self._warm = True
         return len(self._compiled)
 
@@ -303,15 +486,24 @@ class ServeEngine:
     # ------------------------------------------------------------------
     # request path
 
-    def submit(self, raw=None, *, key=None, payload=None, timeout=None):
+    def submit(self, raw=None, *, key=None, payload=None, timeout=None,
+               deadline_s=None):
         """Queue one request; returns a `concurrent.futures.Future`.
 
         With a ``prep_fn``: pass ``raw`` (whatever the prep fn consumes).
         Without one: pass ``key=``/``payload=``. The submit queue is
         BOUNDED (``queue_limit``): when it is full, ``timeout=None``
-        blocks (natural backpressure), ``timeout=0`` raises
-        ``queue.Full`` immediately, and a positive timeout raises after
-        waiting that long.
+        blocks (natural backpressure), ``timeout=0`` raises a typed
+        `AdmissionRejected` (a ``queue.Full`` subclass, with a
+        retry-after hint) immediately, and a positive timeout raises
+        after waiting that long.
+
+        ``deadline_s`` (relative seconds) sets the request's SLO. When
+        the EWMA latency estimate says completion would miss it, the
+        request is SHED at admission: the returned future already holds
+        a `RequestShed` (no queue slot occupied, counted in
+        ``serve_requests_shed_total``). An accepted request whose
+        deadline expires in-pipeline resolves with `DeadlineExceeded`.
         """
         if self._closed:
             raise RuntimeError("submit on a closed ServeEngine")
@@ -322,21 +514,85 @@ class ServeEngine:
                     "key= and payload="
                 )
             raw = (key, payload)
+        now = self._clock()
+        deadline = None if deadline_s is None else now + deadline_s
         fut = Future()
-        item = (raw, fut, time.monotonic())
-        if timeout == 0:
-            self._submit_q.put_nowait(item)  # queue.Full on backpressure
-        else:
-            self._submit_q.put(item, timeout=timeout)
+        if deadline is not None:
+            est = self.estimator.estimate(key)
+            if est is not None:
+                eta = (
+                    self._batcher.max_wait
+                    + est * self._deadline_margin
+                )
+                if now + eta > deadline:
+                    # shed BEFORE occupying a queue slot: the future is
+                    # returned pre-resolved with the typed shed
+                    self._m_submitted.inc()
+                    self._fail(
+                        fut,
+                        RequestShed(
+                            f"estimated completion {eta * 1e3:.1f}ms "
+                            f"exceeds deadline {deadline_s * 1e3:.1f}ms",
+                            reason="admission",
+                            estimated_s=eta,
+                            deadline_s=deadline_s,
+                            retry_after_s=est,
+                        ),
+                    )
+                    return fut
+        item = (raw, fut, now, deadline)
+        try:
+            if timeout == 0:
+                self._submit_q.put_nowait(item)
+            else:
+                self._submit_q.put(item, timeout=timeout)
+        except queue.Full:
+            self._m_rejected.inc()
+            est = self.estimator.estimate(key)
+            raise AdmissionRejected(
+                f"submit queue full ({self._queue_limit} waiting)",
+                retry_after_s=(
+                    est if est is not None else self._batcher.max_wait
+                ),
+            ) from None
+        self._track(fut)
         self._m_submitted.inc()
         return fut
 
-    def _prep_loop(self):
+    # -- prep stage ----------------------------------------------------
+
+    def _prep_worker(self):
+        # single-slot in-flight ledger shared with the supervisor: when
+        # the loop crashes, ONLY the request left here fails
+        inflight = {}
+
+        def on_crash(exc):
+            fut = inflight.pop("fut", None)
+            if fut is not None:
+                self._fail(fut, StageFailure("prep", repr(exc)))
+            self._m_prep_restarts.inc()
+
+        # always restart: close() leaves this worker's sentinel in the
+        # queue, so a post-crash re-entry still terminates promptly
+        run_supervised(lambda: self._prep_loop(inflight), on_crash=on_crash)
+
+    def _prep_loop(self, inflight):
         while True:
             item = self._submit_q.get()
             if item is _SENTINEL:
                 return
-            raw, fut, t_submit = item
+            raw, fut, t_submit, deadline = item
+            inflight["fut"] = fut
+            # a STAGE crash (vs a request failure below) escapes this
+            # loop to the supervisor, which fails only `inflight`
+            faultinject.fire("serve.worker.crash")
+            if deadline is not None and self._clock() > deadline:
+                self._fail(fut, DeadlineExceeded(
+                    "deadline expired while queued for prep",
+                    stage="prep", deadline_s=deadline,
+                ))
+                inflight.pop("fut", None)
+                continue
             try:
                 with trace.span("serve/prep"):
                     # the fault point fires ONCE per request (never
@@ -355,13 +611,68 @@ class ServeEngine:
                     )
             except BaseException as exc:  # a failed request fails ALONE
                 self._fail(fut, exc)
+                inflight.pop("fut", None)
                 continue
-            batch = self._batcher.add(Request(key, payload, fut, t_submit))
+            inflight.pop("fut", None)
+            batch = self._batcher.add(
+                Request(key, payload, fut, t_submit, deadline)
+            )
             if batch is not None:  # the add filled a group to max_batch
                 self._batch_q.put(batch)
 
-    def _dispatch_loop(self):
+    # -- dispatch stage ------------------------------------------------
+
+    def _start_dispatcher(self):
+        gen = self._dispatch_gen
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_worker, args=(gen,),
+            name=f"serve-dispatch-{gen}", daemon=True,
+        )
+        self._dispatcher.start()
+
+    def _dispatch_worker(self, gen):
+        def on_crash(exc):
+            with self._gen_lock:
+                batch = self._inflight_dispatch.pop(gen, None)
+            if batch is not None:
+                for r in batch.requests:
+                    self._fail(r.future, StageFailure("dispatch", repr(exc)))
+            self._m_dispatch_restarts.inc()
+
+        run_supervised(
+            lambda: self._dispatch_loop(gen),
+            on_crash=on_crash,
+            stopping=lambda: self._dispatch_gen != gen,
+        )
+
+    def _on_dispatch_hang(self):
+        """Watchdog verdict: the dispatch thread stopped heartbeating
+        with a batch on the device. Fail that batch typed, supersede the
+        wedged thread (generation bump — it discards its work when it
+        wakes), and take over with a fresh one."""
+        with self._gen_lock:
+            gen = self._dispatch_gen
+            batch = self._inflight_dispatch.pop(gen, None)
+            if batch is None:
+                return  # raced with a completing dispatch: not a hang
+            self._dispatch_gen = gen + 1
+            self._dispatch_beat = self._clock()
+        self._m_hangs.inc()
+        self._m_dispatch_restarts.inc()
+        for r in batch.requests:
+            self._fail(r.future, StageFailure(
+                "dispatch",
+                f"no heartbeat for > {self._watchdog.timeout:.3f}s",
+                hang=True,
+            ))
+        self._start_dispatcher()
+
+    def _dispatch_loop(self, gen):
         while True:
+            if self._dispatch_gen != gen:
+                return  # superseded by hang recovery
+            self._dispatch_beat = self._clock()
+            self._update_degrade()
             stopping = self._stop_dispatch.is_set()
             nd = self._batcher.next_deadline()
             wait = 0.0 if stopping else min(
@@ -371,23 +682,67 @@ class ServeEngine:
                 batch = self._batch_q.get(timeout=wait)
             except queue.Empty:
                 batch = None
+            if self._dispatch_gen != gen:
+                if batch is not None:
+                    self._batch_q.put(batch)  # hand back to the successor
+                return
             if batch is not None:
-                self._dispatch(batch)
+                self._dispatch(batch, gen)
             for b in self._batcher.ready():
-                self._dispatch(b)
+                self._dispatch(b, gen)
             if stopping and batch is None and self._batch_q.empty():
                 # prep workers are already joined: nothing new can
                 # arrive, so one final drain flushes trailing partials
                 for b in self._batcher.drain():
-                    self._dispatch(b)
+                    self._dispatch(b, gen)
                 if self._batch_q.empty():
                     return
 
-    def _dispatch(self, batch):
+    def _dispatch(self, batch, gen):
+        with self._gen_lock:
+            if self._dispatch_gen != gen:
+                self._batch_q.put(batch)
+                return
+            self._inflight_dispatch[gen] = batch
+        # stage-level fault point: delay:<s> wedges the thread here (the
+        # hang drill — the watchdog must recover), crash escapes to the
+        # stage supervisor. NO try around it: an escape must leave
+        # `_inflight_dispatch` set so the supervisor/watchdog can fail
+        # exactly the in-flight batch.
+        faultinject.fire("serve.dispatch.hang")
+        if self._dispatch_gen != gen:
+            # woke from a hang after supersession: the watchdog already
+            # failed these futures; discard
+            with self._gen_lock:
+                self._inflight_dispatch.pop(gen, None)
+            return
         with trace.span("serve/dispatch"):
-            self._dispatch_inner(batch)
+            self._dispatch_inner(batch, gen)
+        with self._gen_lock:
+            self._inflight_dispatch.pop(gen, None)
 
-    def _dispatch_inner(self, batch):
+    def _dispatch_inner(self, batch, gen):
+        # drop requests whose deadline already expired: they would
+        # occupy device rows nobody is waiting for
+        now = self._clock()
+        live, expired = [], []
+        for r in batch.requests:
+            if r.deadline is not None and now > r.deadline:
+                expired.append(r)
+            else:
+                live.append(r)
+        for r in expired:
+            self._fail(r.future, DeadlineExceeded(
+                "deadline expired before dispatch",
+                stage="dispatch", deadline_s=r.deadline,
+            ))
+        if not live:
+            return
+        if expired:
+            batch = MicroBatch(
+                batch.key, live, pad_size(len(live), self.batch_sizes)
+            )
+        degraded = self._degraded_now()
         try:
             reqs = batch.requests
             names = sorted(reqs[0].payload)
@@ -401,8 +756,9 @@ class ServeEngine:
                 stacked[name] = np.stack(arrs)
             exe = self._executable(
                 batch.key, batch.pad_to, payload_spec(reqs[0].payload),
-                live=True,
+                live=True, degraded=degraded,
             )
+            t_dispatch = self._clock()
             out = exe(self._params, stacked)
             # start D2H immediately; the readout thread's np.asarray
             # then finds the bytes already on their way
@@ -412,39 +768,129 @@ class ServeEngine:
             for r in batch.requests:
                 self._fail(r.future, exc)
             return
-        self._readout_q.put((batch, out))
+        if self._dispatch_gen != gen:
+            return  # superseded mid-call; the watchdog settled the batch
+        self._readout_q.put((batch, out, t_dispatch, degraded))
 
-    def _readout_loop(self):
+    # -- degradation controller ----------------------------------------
+
+    def _degraded_now(self):
+        return (
+            self.controller is not None
+            and self._jit_degraded is not None
+            and self.controller.degraded
+        )
+
+    def _update_degrade(self):
+        if self.controller is None or self._jit_degraded is None:
+            return
+        pressure = (
+            self._submit_q.qsize()
+            + self._batcher.pending()
+            + self._batch_q.qsize()
+        ) / max(1, self._queue_limit)
+        was = self.controller.degraded
+        if self.controller.update(pressure) != was:
+            self._m_flips.inc()
+
+    # -- readout stage -------------------------------------------------
+
+    def _readout_worker(self):
+        inflight = {}
+
+        def on_crash(exc):
+            batch = inflight.pop("batch", None)
+            if batch is not None:
+                for r in batch.requests:
+                    self._fail(r.future, StageFailure("readout", repr(exc)))
+            self._m_readout_restarts.inc()
+
+        run_supervised(
+            lambda: self._readout_loop(inflight), on_crash=on_crash
+        )
+
+    def _readout_loop(self, inflight):
         while True:
             item = self._readout_q.get()
             if item is _SENTINEL:
                 return
-            batch, out = item
+            batch, out, t_dispatch, degraded = item
+            inflight["batch"] = batch
+            # stage-level fault: delay:<s> models a slow D2H/convert
+            # (the readout-deadline drill), crash escapes to the
+            # supervisor, which fails only this batch
+            faultinject.fire("serve.readout.delay")
             with trace.span("serve/readout"):
                 try:
                     host = jax.tree_util.tree_map(np.asarray, out)
                 except BaseException as exc:
                     for r in batch.requests:
                         self._fail(r.future, exc)
+                    inflight.pop("batch", None)
                     continue
-                now = time.monotonic()
+                now = self._clock()
                 n = len(batch.requests)
+                # feed admission control: per-bucket EWMA of
+                # dispatch -> readout-complete latency
+                self.estimator.observe(batch.key, max(0.0, now - t_dispatch))
                 self._m_batches.inc()
                 self._m_real.inc(n)
                 self._m_padded.inc(batch.pad_to)
-                self._m_completed.inc(n)
+                if degraded:
+                    self._m_degraded_batches.inc()
                 self._m_batch_size.observe(n)
-                for r in batch.requests:
-                    self._m_latency.observe(now - r.t_submit)
                 for i, r in enumerate(batch.requests):
+                    if r.deadline is not None and now > r.deadline:
+                        self._fail(r.future, DeadlineExceeded(
+                            "deadline expired before readout completed",
+                            stage="readout", deadline_s=r.deadline,
+                        ))
+                        continue
                     # padding masked here: only rows [0, n) are ever read
-                    r.future.set_result(
-                        jax.tree_util.tree_map(lambda a: a[i], host)
-                    )
+                    if self._settle_result(
+                        r.future,
+                        jax.tree_util.tree_map(lambda a, i=i: a[i], host),
+                    ):
+                        self._m_completed.inc()
+                        self._m_latency.observe(now - r.t_submit)
+            inflight.pop("batch", None)
+
+    # -- settlement (every accepted future resolves EXACTLY once) ------
+
+    def _track(self, fut):
+        with self._pending_lock:
+            self._pending.add(fut)
+
+    def _settle_result(self, fut, value):
+        with self._pending_lock:
+            self._pending.discard(fut)
+        try:
+            fut.set_result(value)
+            return True
+        except InvalidStateError:
+            return False  # already settled (watchdog/drain won the race)
+
+    def _settle_exc(self, fut, exc):
+        with self._pending_lock:
+            self._pending.discard(fut)
+        try:
+            fut.set_exception(exc)
+            return True
+        except InvalidStateError:
+            return False
 
     def _fail(self, fut, exc):
-        self._m_failed.inc()
-        fut.set_exception(exc)
+        if not self._settle_exc(fut, exc):
+            return
+        # counters route by outcome TYPE, and only on the settling
+        # transition, so submitted == completed + failed + shed +
+        # deadline_exceeded holds exactly
+        if isinstance(exc, DeadlineExceeded):
+            self._m_deadline.inc()
+        elif isinstance(exc, RequestShed):
+            self._m_shed.inc()
+        else:
+            self._m_failed.inc()
 
     # ------------------------------------------------------------------
     # lifecycle / accounting
@@ -452,6 +898,10 @@ class ServeEngine:
     def _mean_occupancy(self):
         padded = self._m_padded.value
         return self._m_real.value / padded if padded else float("nan")
+
+    @property
+    def closed(self):
+        return self._closed
 
     def report(self):
         """Snapshot of serving stats: counts, mean batch occupancy,
@@ -463,10 +913,22 @@ class ServeEngine:
             "submitted": self._m_submitted.value,
             "completed": self._m_completed.value,
             "failed": self._m_failed.value,
+            "shed": self._m_shed.value,
+            "deadline_exceeded": self._m_deadline.value,
+            "admission_rejected": self._m_rejected.value,
             "batches": self._m_batches.value,
             "real_samples": self._m_real.value,
             "padded_samples": self._m_padded.value,
             "recompiles_after_warmup": self._m_recompiles.value,
+            "degraded_batches": self._m_degraded_batches.value,
+            "degrade_flips": self._m_flips.value,
+            "degraded_mode": self._degraded_now(),
+            "dispatch_hangs": self._m_hangs.value,
+            "stage_restarts": {
+                "prep": self._m_prep_restarts.value,
+                "dispatch": self._m_dispatch_restarts.value,
+                "readout": self._m_readout_restarts.value,
+            },
         }
         s["mean_occupancy"] = self._mean_occupancy()
         s["compiles"] = self._trace_count
@@ -476,20 +938,65 @@ class ServeEngine:
         s["latencies_s"] = lat
         return s
 
-    def close(self):
-        """Drain in-flight work (every accepted future resolves), then
-        join all pipeline threads. Idempotent."""
-        if self._closed:
+    def shutdown(self, timeout=None):
+        """Stop admission and drain; EVERY accepted future resolves
+        before this returns. With ``timeout=None`` the drain blocks
+        until all in-flight work finishes (the pre-PR-10 `close`
+        semantics). With a finite timeout, whatever has not resolved
+        when it expires is failed with a typed ``RequestShed
+        (reason="drain")`` — results for slow stragglers are dropped,
+        but no caller is ever left holding an unresolved future.
+        Idempotent."""
+        with self._close_lock:
+            already = self._closed
+            self._closed = True
+        if already:
+            # a concurrent shutdown owns the drain (e.g. the preemption
+            # watcher): BLOCK until it finishes so "returned => every
+            # accepted future resolved" holds for every caller, not just
+            # the first
+            self._drained.wait(timeout)
             return
-        self._closed = True
+        deadline = (
+            None if timeout is None else self._clock() + timeout
+        )
+
+        def remaining():
+            if deadline is None:
+                return None
+            return max(0.0, deadline - self._clock())
+
         for _ in self._workers:
             self._submit_q.put(_SENTINEL)
         for t in self._workers:
-            t.join()
+            t.join(remaining())
         self._stop_dispatch.set()
-        self._dispatcher.join()
-        self._readout_q.put(_SENTINEL)
-        self._reader.join()
+        self._dispatcher.join(remaining())
+        try:
+            self._readout_q.put(_SENTINEL, timeout=remaining())
+        except queue.Full:
+            pass  # readout wedged; its futures are failed below
+        self._reader.join(remaining())
+        if self._watchdog is not None:
+            self._watchdog.stop(remaining())
+        # the drain ledger: anything still pending missed the deadline
+        with self._pending_lock:
+            leftovers = list(self._pending)
+        for fut in leftovers:
+            self._fail(fut, RequestShed(
+                "drain deadline expired before this request resolved",
+                reason="drain",
+            ))
+        self._drained.set()
+
+    def drain(self, timeout=None):
+        """Alias for `shutdown` — the name `drain_on_preemption` calls."""
+        self.shutdown(timeout=timeout)
+
+    def close(self):
+        """Drain in-flight work (every accepted future resolves), then
+        join all pipeline threads. Idempotent."""
+        self.shutdown(timeout=None)
 
     def __enter__(self):
         return self

@@ -1,0 +1,322 @@
+"""SLO-aware serving resilience: typed outcomes, admission control,
+overload degradation, and stage supervision primitives.
+
+PR 6's `ServeEngine` had a happy-path story only: bounded queues give
+backpressure, but a request with a latency budget could still be accepted
+and then miss it, a crashed prep worker silently shrank the worker pool,
+a hung dispatch wedged the whole pipeline, and overload had no knob other
+than "queue up". This module holds the policy pieces the engine now wires
+together:
+
+* **Typed outcomes** — every accepted future resolves with either a
+  result or one of the exception types below; callers can branch on type
+  instead of parsing tracebacks:
+
+  - :class:`RequestShed` — the engine *chose* not to serve the request
+    (admission-control shed, or a drain deadline expired first). Carries
+    the estimate/deadline that drove the decision and a ``retry_after_s``
+    hint.
+  - :class:`DeadlineExceeded` — the request was accepted but its deadline
+    expired in-pipeline; it is dropped at the prep / dispatch / readout
+    stage named by ``stage`` rather than occupying a device slot.
+  - :class:`AdmissionRejected` — ``submit`` refused the request because
+    the bounded queue is full. Subclasses ``queue.Full`` (callers that
+    handled backpressure before this PR keep working) but adds the
+    ``retry_after_s`` hint.
+  - :class:`StageFailure` — the request was in flight on a pipeline stage
+    that crashed or hung; ONLY in-flight requests fail this way, the
+    stage restarts, and the warm compile cache survives
+    (``recompiles_after_warmup`` stays 0 — drilled in
+    tests/test_serve_resilience.py).
+
+* :class:`LatencyEstimator` — per-bucket EWMA of batch latency (the same
+  samples the telemetry latency histograms retain), the completion-time
+  estimate admission control sheds against.
+
+* :class:`HysteresisController` — the overload -> degraded-program
+  controller: queue pressure above ``high`` for ``up_count`` consecutive
+  observations flips dispatch to the cheap pre-warmed ``nc_topk`` band
+  program; pressure below ``low`` for ``down_count`` observations flips
+  back. The two thresholds plus the dwell counts are the hysteresis —
+  pressure oscillating around one threshold cannot make the controller
+  thrash programs.
+
+* :func:`run_supervised` / :class:`Watchdog` — the supervision
+  primitives: a stage loop that restarts after a crash (after the
+  engine's ``on_crash`` fails the in-flight futures with
+  :class:`StageFailure`), and a heartbeat watchdog that detects a hung
+  dispatch (a thread stuck inside a device call cannot be killed in
+  Python, so recovery is: fail its in-flight batch, bump the dispatch
+  generation so the wedged thread discards its work when it wakes, and
+  start a fresh dispatch thread).
+
+* :func:`drain_on_preemption` — SIGTERM (via the existing
+  `resilience.signals.PreemptionGuard`) -> stop admission and drain
+  under a deadline, resolving every accepted future with a result or a
+  typed :class:`RequestShed`.
+
+Import-light by contract (stdlib only): the engine imports this on every
+serving path.
+"""
+
+import queue
+import threading
+import time
+
+
+class ServeResilienceError(RuntimeError):
+    """Base of every typed serving-resilience outcome."""
+
+
+class RequestShed(ServeResilienceError):
+    """The engine declined to serve the request (load shedding).
+
+    ``reason`` is machine-readable: ``"admission"`` (estimated completion
+    would miss the deadline — shed before occupying any queue slot) or
+    ``"drain"`` (a drain deadline expired with the request unresolved).
+    """
+
+    def __init__(self, message, *, reason, estimated_s=None,
+                 deadline_s=None, retry_after_s=None):
+        super().__init__(message)
+        self.reason = reason
+        self.estimated_s = estimated_s
+        self.deadline_s = deadline_s
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceeded(RequestShed):
+    """An accepted request's deadline expired in-pipeline; it was dropped
+    at ``stage`` ('prep', 'dispatch', or 'readout') instead of wasting a
+    device slot on a result nobody is waiting for."""
+
+    def __init__(self, message, *, stage, deadline_s=None):
+        super().__init__(
+            message, reason="deadline", deadline_s=deadline_s
+        )
+        self.stage = stage
+
+
+class AdmissionRejected(ServeResilienceError, queue.Full):
+    """``submit`` refused the request: the bounded submit queue is full.
+
+    Subclasses ``queue.Full`` so pre-existing backpressure handling keeps
+    working; ``retry_after_s`` is the engine's estimate of when a slot is
+    likely to free up (one batch latency), the hint a client or an HTTP
+    front end maps to ``Retry-After``.
+    """
+
+    def __init__(self, message, *, retry_after_s=None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class StageFailure(ServeResilienceError):
+    """A pipeline stage crashed or hung while this request was in flight
+    on it. Only in-flight requests fail this way; the stage restarted and
+    subsequent requests are served from the intact warm compile cache."""
+
+    def __init__(self, stage, message, *, hang=False):
+        super().__init__(f"serve {stage} stage "
+                         f"{'hang' if hang else 'failure'}: {message}")
+        self.stage = stage
+        self.hang = hang
+
+
+# ----------------------------------------------------------------------
+# admission control: the completion-time estimate
+
+
+class LatencyEstimator:
+    """EWMA of per-bucket batch latency (dispatch -> readout complete).
+
+    ``observe(key, s)`` feeds one batch's latency (the engine calls it at
+    readout, alongside the telemetry histogram's ``observe``);
+    ``estimate(key)`` returns the per-key EWMA, falling back to the
+    cross-bucket EWMA when the key is unknown (the ``prep_fn`` path
+    cannot know its bucket at submit time), and None before any
+    observation — admission control admits blind until the first batch
+    has been measured rather than shedding on a guess.
+    """
+
+    def __init__(self, alpha=0.25):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.alpha = alpha
+        self._lock = threading.Lock()
+        self._per_key = {}
+        self._global = None
+
+    def observe(self, key, seconds):
+        s = float(seconds)
+        with self._lock:
+            prev = self._per_key.get(key)
+            self._per_key[key] = (
+                s if prev is None else prev + self.alpha * (s - prev)
+            )
+            self._global = (
+                s if self._global is None
+                else self._global + self.alpha * (s - self._global)
+            )
+
+    def estimate(self, key=None):
+        with self._lock:
+            if key is not None and key in self._per_key:
+                return self._per_key[key]
+            return self._global
+
+
+# ----------------------------------------------------------------------
+# overload degradation: the hysteresis controller
+
+
+class HysteresisController:
+    """Queue-pressure -> degraded-mode controller with hysteresis.
+
+    ``update(pressure)`` is called by the engine's dispatch thread (every
+    loop iteration, so it keeps observing while idle and can flip BACK
+    when pressure clears) and returns the current mode. ``pressure`` is
+    the engine's queued-work fraction (queued requests / queue limit).
+
+    Flip up: ``pressure >= high`` for ``up_count`` consecutive updates.
+    Flip down: ``pressure <= low`` for ``down_count`` consecutive
+    updates. Readings in the dead band (low, high) reset both streaks —
+    mid-band noise keeps the current mode, which is the point of the
+    hysteresis.
+    """
+
+    def __init__(self, high=0.75, low=0.25, up_count=2, down_count=4):
+        if not low < high:
+            raise ValueError(
+                f"hysteresis needs low < high, got low={low} high={high}"
+            )
+        if up_count < 1 or down_count < 1:
+            raise ValueError("up_count and down_count must be >= 1")
+        self.high = high
+        self.low = low
+        self.up_count = up_count
+        self.down_count = down_count
+        self.degraded = False
+        self.flips = 0
+        self.last_pressure = 0.0
+        self._above = 0
+        self._below = 0
+
+    def update(self, pressure):
+        p = float(pressure)
+        self.last_pressure = p
+        if p >= self.high:
+            self._above += 1
+            self._below = 0
+        elif p <= self.low:
+            self._below += 1
+            self._above = 0
+        else:
+            self._above = 0
+            self._below = 0
+        if not self.degraded and self._above >= self.up_count:
+            self.degraded = True
+            self.flips += 1
+            self._above = 0
+        elif self.degraded and self._below >= self.down_count:
+            self.degraded = False
+            self.flips += 1
+            self._below = 0
+        return self.degraded
+
+
+# ----------------------------------------------------------------------
+# supervision: restart-on-crash stage loops + the dispatch watchdog
+
+
+def run_supervised(loop_fn, *, on_crash, stopping=None):
+    """Run a pipeline-stage loop under crash supervision.
+
+    ``loop_fn()`` returning normally ends the stage (it saw its shutdown
+    sentinel). An escaped exception is a STAGE crash — request-level
+    failures are caught inside the loop and fail only their own future —
+    so ``on_crash(exc)`` runs (the engine fails the in-flight future with
+    a typed :class:`StageFailure` and counts the restart) and the loop
+    re-enters: the restart. ``stopping()`` (optional) short-circuits the
+    restart when the stage has been superseded (a stale dispatch
+    generation) or the engine is tearing down.
+    """
+    while True:
+        try:
+            loop_fn()
+            return
+        except BaseException as exc:  # noqa: BLE001 — supervision boundary
+            on_crash(exc)
+            if stopping is not None and stopping():
+                return
+
+
+class Watchdog:
+    """Heartbeat watchdog for the dispatch stage.
+
+    Polls every ``timeout / 4`` seconds; when ``busy_fn()`` reports
+    in-flight work AND ``clock() - beat_fn()`` exceeds ``timeout``, calls
+    ``on_hang()`` once per hang (the engine fails the in-flight batch,
+    bumps the dispatch generation, and starts a fresh dispatch thread —
+    the next poll then sees the new thread's heartbeat).
+
+    ``timeout`` must exceed the worst-case single-batch latency
+    (including any live compile of an unwarmed bucket), or a legitimately
+    long device call reads as a hang.
+    """
+
+    def __init__(self, timeout, *, beat_fn, busy_fn, on_hang,
+                 clock=time.monotonic):
+        if timeout <= 0:
+            raise ValueError(f"watchdog timeout must be > 0, got {timeout}")
+        self.timeout = timeout
+        self._beat_fn = beat_fn
+        self._busy_fn = busy_fn
+        self._on_hang = on_hang
+        self._clock = clock
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-watchdog", daemon=True
+        )
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        poll = self.timeout / 4.0
+        while not self._stop.wait(poll):
+            if not self._busy_fn():
+                continue
+            if self._clock() - self._beat_fn() > self.timeout:
+                self._on_hang()
+
+    def stop(self, join_timeout=None):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(join_timeout)
+
+
+# ----------------------------------------------------------------------
+# graceful drain on preemption
+
+
+def drain_on_preemption(engine, guard, *, timeout=None, poll_s=0.05):
+    """Watch a `PreemptionGuard`; when it trips (SIGTERM/SIGINT), stop
+    admission and drain the engine under ``timeout`` seconds — every
+    accepted future resolves with its result or a typed
+    :class:`RequestShed`. Returns the watcher thread; the caller joins it
+    (or simply exits — it is a daemon)."""
+
+    def _watch():
+        while not engine.closed:
+            if guard.requested:
+                engine.drain(timeout=timeout)
+                return
+            time.sleep(poll_s)
+
+    t = threading.Thread(
+        target=_watch, name="serve-preemption-drain", daemon=True
+    )
+    t.start()
+    return t

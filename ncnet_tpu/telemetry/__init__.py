@@ -16,8 +16,10 @@ the Gemma-on-TPU serving study):
   * `export` — append-only JSONL event log (durable-append discipline,
     ``telemetry.write`` fault point) and Prometheus text snapshots;
   * `session` — ``start(dir)`` / ``stop()``, the ``--telemetry DIR``
-    contract: one run produces ``events.jsonl`` + ``metrics.prom``,
-    rendered by ``scripts/telemetry_report.py``;
+    contract: one run produces ``events_proc<P>.jsonl`` +
+    ``metrics_proc<P>.prom`` PER PROCESS (multihost runs share one dir
+    without clobbering), rendered by ``scripts/telemetry_report.py``,
+    which also still reads the legacy single ``events.jsonl`` layout;
   * `profiler` — the `jax.profiler` capture window
     (``--profile-dir DIR --profile-steps A:B``).
 

@@ -26,9 +26,43 @@ from ncnet_tpu.resilience import durable, faultinject
 
 SCHEMA_VERSION = 1
 
-# Canonical file names inside a ``--telemetry DIR`` run directory.
+# Legacy (pre-PR-10, single-process) file names inside a ``--telemetry
+# DIR`` run directory. Writers now use the per-process names below —
+# multihost runs share one run dir and must not clobber one file — and
+# readers (`find_event_logs`, `scripts/telemetry_report.py`) accept both
+# layouts.
 EVENTS_NAME = "events.jsonl"
 PROM_NAME = "metrics.prom"
+
+
+def events_name(process_index):
+    """Per-process event-log file name (``events_proc<P>.jsonl``)."""
+    return f"events_proc{int(process_index)}.jsonl"
+
+
+def prom_name(process_index):
+    """Per-process Prometheus snapshot name (``metrics_proc<P>.prom``)."""
+    return f"metrics_proc{int(process_index)}.prom"
+
+
+def find_event_logs(run_dir):
+    """Every event log in a run dir, sorted: the legacy single-process
+    ``events.jsonl`` (if present) plus the per-process
+    ``events_proc<P>.jsonl`` files ordered by process index."""
+    out = []
+    legacy = os.path.join(run_dir, EVENTS_NAME)
+    if os.path.isfile(legacy):
+        out.append(legacy)
+    procs = []
+    for name in os.listdir(run_dir):
+        if name.startswith("events_proc") and name.endswith(".jsonl"):
+            try:
+                p = int(name[len("events_proc"):-len(".jsonl")])
+            except ValueError:
+                continue
+            procs.append((p, os.path.join(run_dir, name)))
+    out.extend(path for _, path in sorted(procs))
+    return out
 
 
 def _json_default(obj):

@@ -122,8 +122,8 @@ class Gauge:
         if fn is not None:
             try:
                 return fn()
-            except Exception:
-                return float("nan")  # a dead callback must not kill a scrape
+            except Exception:  # nclint: disable=swallowed-exception -- a dead gauge callback must read as NaN, never kill a scrape
+                return float("nan")
         return self._value
 
     def snapshot(self):

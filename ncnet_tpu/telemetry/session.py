@@ -1,10 +1,12 @@
 """Telemetry sessions: one ``--telemetry DIR`` run, one event log.
 
-`start(out_dir)` opens the JSONL event log (``events.jsonl``), enables
-the span tracer with the log as its sink, and remembers which registry
-to snapshot; `stop()` appends a final metric record per registered
-metric, closes the log, and durably writes the Prometheus snapshot
-(``metrics.prom``). The CLIs (`scripts/train.py`, `scripts/serve.py`,
+`start(out_dir)` opens the JSONL event log
+(``events_proc<P>.jsonl`` — per-PROCESS, so every host of a multihost
+run can share one ``--telemetry DIR`` without clobbering a single
+file), enables the span tracer with the log as its sink, and remembers
+which registry to snapshot; `stop()` appends a final metric record per
+registered metric, closes the log, and durably writes the Prometheus
+snapshot (``metrics_proc<P>.prom``). The CLIs (`scripts/train.py`, `scripts/serve.py`,
 ``bench.py``) wrap their work in exactly this pair, so a single run of
 any of them produces the one schema `scripts/telemetry_report.py`
 renders.
@@ -14,16 +16,17 @@ singleton), so a second concurrent session would interleave sinks.
 """
 
 import os
+import sys
 import threading
 import time
 
 from ncnet_tpu.telemetry import trace
 from ncnet_tpu.telemetry.export import (
-    EVENTS_NAME,
-    PROM_NAME,
     SCHEMA_VERSION,
     JsonlWriter,
+    events_name,
     metric_events,
+    prom_name,
     write_prometheus,
 )
 from ncnet_tpu.telemetry.registry import default_registry
@@ -32,13 +35,32 @@ _lock = threading.Lock()
 _active = None
 
 
+def _process_index():
+    """Multihost process index for the per-process file names.
+
+    Telemetry stays importable without jax by contract, so this only
+    ASKS jax when something else already imported it; single-process
+    runs (and jax-free consumers) get index 0.
+    """
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return 0
+    try:
+        return int(jax_mod.process_index())
+    except Exception:  # nclint: disable=swallowed-exception -- a partially-initialized or backendless jax degrades to single-process telemetry; session start must never fail
+        return 0
+
+
 class TelemetrySession:
     def __init__(self, out_dir, registry=None, label=None):
         self.out_dir = out_dir
         self.registry = registry if registry is not None else default_registry()
         os.makedirs(out_dir, exist_ok=True)
-        self.events_path = os.path.join(out_dir, EVENTS_NAME)
-        self.prom_path = os.path.join(out_dir, PROM_NAME)
+        self.process_index = _process_index()
+        self.events_path = os.path.join(
+            out_dir, events_name(self.process_index)
+        )
+        self.prom_path = os.path.join(out_dir, prom_name(self.process_index))
         self.writer = JsonlWriter(self.events_path)
         self.writer.write({
             "type": "meta",
@@ -46,6 +68,7 @@ class TelemetrySession:
             "ts": time.time(),
             "label": label,
             "pid": os.getpid(),
+            "process_index": self.process_index,
         })
         trace.enable(sink=self.writer.write)
         self._stopped = False

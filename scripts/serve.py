@@ -25,13 +25,35 @@ Modes:
                            jitted per-pair call, host prep inline): the
                            denominator of the speedup PERF.md records
 
-Fault drills: the engine fires the ``serve.request`` fault point per
-request, so ``NCNET_FAULTS="serve.request=delay:0.5@3"`` (etc.) exercises
-slow/failed requests from the command line without code changes.
+SLOs & resilience (ncnet_tpu.serve.resilience):
+  --deadline-ms N          per-request deadline; requests the EWMA says
+                           cannot finish in time are SHED at admission,
+                           accepted ones whose budget expires in-pipeline
+                           resolve with DeadlineExceeded — both tallied
+  --admission-timeout-ms   bound submit blocking; on a full queue the
+                           client sees a typed AdmissionRejected with a
+                           retry-after hint and retries (counted)
+  --degrade K              pre-warm a second program at nc_topk=K and let
+                           the hysteresis controller flip dispatch to it
+                           under sustained queue pressure (back when it
+                           clears); flips + degraded batches reported
+  --hang-timeout S         dispatch heartbeat watchdog (must exceed the
+                           worst-case batch latency incl. live compiles)
+  --drain-timeout S        SIGTERM stops admission and drains under this
+                           deadline; every accepted future resolves
+                           (result or typed shed) before exit
+
+Fault drills: the engine fires the ``serve.request``,
+``serve.worker.crash``, ``serve.dispatch.hang``, and
+``serve.readout.delay`` points, so e.g.
+``NCNET_FAULTS="serve.worker.crash=crash@3"`` proves from the command
+line that a crashed prep worker fails ONLY its in-flight request
+(typed StageFailure), restarts, and recompiles_after_warmup stays 0.
 
 Example:
   python scripts/serve.py --checkpoint ck.msgpack --pairs req.csv \
-      --concurrency 8 --max-batch 8 --report serve_report.json
+      --concurrency 8 --max-batch 8 --deadline-ms 250 --degrade 16 \
+      --report serve_report.json
 """
 
 import argparse
@@ -91,13 +113,37 @@ def parse_args(argv=None):
     p.add_argument("--sequential", action="store_true",
                    help="run the per-pair sequential baseline instead of "
                         "the batched engine")
+    p.add_argument("--deadline-ms", type=float, default=0.0,
+                   help="per-request SLO deadline in ms (0 disables); "
+                        "drives admission-control shedding and "
+                        "in-pipeline deadline drops")
+    p.add_argument("--admission-timeout-ms", type=float, default=-1.0,
+                   help="max ms submit may block on a full queue before "
+                        "AdmissionRejected (client retries after its "
+                        "hint; -1 blocks indefinitely, 0 never blocks)")
+    p.add_argument("--degrade", type=int, default=-1,
+                   help="nc_topk for the DEGRADED program the overload "
+                        "controller flips to (-1 disables degradation)")
+    p.add_argument("--degrade-high", type=float, default=0.75,
+                   help="queue-pressure fraction that flips dispatch to "
+                        "the degraded program (hysteresis high water)")
+    p.add_argument("--degrade-low", type=float, default=0.25,
+                   help="queue-pressure fraction that flips back "
+                        "(hysteresis low water)")
+    p.add_argument("--hang-timeout", type=float, default=30.0,
+                   help="dispatch heartbeat watchdog seconds (0 "
+                        "disables); must exceed the worst-case batch "
+                        "latency including live compiles")
+    p.add_argument("--drain-timeout", type=float, default=10.0,
+                   help="graceful-drain deadline on SIGTERM/shutdown: "
+                        "unresolved futures past it get a typed shed")
     p.add_argument("--report", type=str, default=None,
                    help="write the JSON report here too")
     p.add_argument("--telemetry", type=str, default=None, metavar="DIR",
                    help="write a telemetry run under DIR "
                         "(ncnet_tpu.telemetry): the engine's metrics and "
-                        "per-stage spans land in a durable events.jsonl "
-                        "plus a metrics.prom snapshot at exit; render "
+                        "per-stage spans land in a durable per-process "
+                        "events_proc<P>.jsonl plus a .prom snapshot; render "
                         "with scripts/telemetry_report.py DIR")
     return p.parse_args(argv)
 
@@ -173,9 +219,15 @@ def _run(args, telemetry):
         normalize_image_np,
         resize_bilinear_np,
     )
+    from ncnet_tpu.resilience.signals import PreemptionGuard
     from ncnet_tpu.serve import (
+        AdmissionRejected,
         BucketSpec,
+        DeadlineExceeded,
+        HysteresisController,
+        RequestShed,
         ServeEngine,
+        drain_on_preemption,
         make_serve_match_step,
         pair_bucket,
         payload_spec,
@@ -245,6 +297,20 @@ def _run(args, telemetry):
     apply_fn = make_serve_match_step(
         config, from_features=bool(args.feature_store)
     )
+    degraded_apply_fn = None
+    controller = None
+    if args.degrade >= 0:
+        # the overload fallback: the SAME serving forward at a sparse
+        # nc_topk band (arXiv:2004.10566 reproduction, PR 4) — ~3x
+        # analytic NC FLOP reduction at K=16, pre-warmed alongside the
+        # dense program so a flip never compiles
+        degraded_apply_fn = make_serve_match_step(
+            config.replace(nc_topk=args.degrade),
+            from_features=bool(args.feature_store),
+        )
+        controller = HysteresisController(
+            high=args.degrade_high, low=args.degrade_low
+        )
 
     report = {
         "mode": "sequential" if args.sequential else "serve",
@@ -254,6 +320,8 @@ def _run(args, telemetry):
         "max_wait_ms": args.max_wait_ms,
         "nc_topk": int(config.nc_topk),
         "feature_store": bool(args.feature_store),
+        "deadline_ms": args.deadline_ms,
+        "degrade_topk": args.degrade,
     }
 
     if args.sequential:
@@ -288,7 +356,14 @@ def _run(args, telemetry):
         for pname, v in percentiles(m_lat.samples).items():
             report[f"latency_{pname}_ms"] = float(v) * 1e3
     else:
-        with ServeEngine(
+        deadline_s = (
+            args.deadline_ms / 1e3 if args.deadline_ms > 0 else None
+        )
+        adm_timeout = (
+            None if args.admission_timeout_ms < 0
+            else args.admission_timeout_ms / 1e3
+        )
+        with PreemptionGuard() as guard, ServeEngine(
             apply_fn,
             params,
             max_batch=args.max_batch,
@@ -299,7 +374,17 @@ def _run(args, telemetry):
             prep_retries=args.prep_retries,
             registry=(telemetry.default_registry() if args.telemetry
                       else None),
+            degraded_apply_fn=degraded_apply_fn,
+            degrade_controller=controller,
+            hang_timeout=(
+                args.hang_timeout if args.hang_timeout > 0 else None
+            ),
         ) as engine:
+            # SIGTERM -> stop admission (clients poll guard.requested),
+            # drain under the deadline: every accepted future resolves
+            drain_on_preemption(
+                engine, guard, timeout=args.drain_timeout
+            )
             # warmup: one prep per distinct bucket discovers the payload
             # spec (for images this only needs the file header; the
             # feature path additionally primes the store), then every
@@ -315,20 +400,41 @@ def _run(args, telemetry):
             n_programs = engine.warmup(seen.values())
             report["buckets"] = len(seen)
             report["compiled_programs"] = n_programs
+            print(f"warmup: {n_programs} programs over {len(seen)} "
+                  f"bucket(s); serving {len(requests)} requests",
+                  flush=True)
 
-            futures = []
             fut_lock = threading.Lock()
             idx = iter(range(len(requests)))
             slots = [None] * len(requests)
+            tally = {"admission_retries": 0}
 
             def client():
                 while True:
+                    if guard.requested:
+                        return  # admission stopped: drain in progress
                     with fut_lock:
                         i = next(idx, None)
                     if i is None:
                         return
-                    fut = engine.submit(requests[i])
-                    slots[i] = fut
+                    while True:
+                        try:
+                            slots[i] = engine.submit(
+                                requests[i],
+                                timeout=adm_timeout,
+                                deadline_s=deadline_s,
+                            )
+                            break
+                        except AdmissionRejected as exc:
+                            # typed backpressure: honor the engine's
+                            # retry-after hint instead of hot-spinning
+                            with fut_lock:
+                                tally["admission_retries"] += 1
+                            time.sleep(exc.retry_after_s or 0.005)
+                            if guard.requested:
+                                return
+                        except RuntimeError:
+                            return  # engine closed mid-drain
 
             t0 = time.perf_counter()
             threads = [
@@ -339,11 +445,22 @@ def _run(args, telemetry):
                 t.start()
             for t in threads:
                 t.join()
-            failed = 0
+            # bounded drain (idempotent with the context close): after
+            # this EVERY accepted future below is resolved
+            engine.drain(timeout=args.drain_timeout)
+            ok = failed = shed = deadline_exceeded = unsubmitted = 0
             for fut in slots:
+                if fut is None:
+                    unsubmitted += 1  # preemption stopped admission
+                    continue
                 try:
-                    fut.result()
-                except Exception:
+                    fut.result(timeout=0)
+                    ok += 1
+                except DeadlineExceeded:
+                    deadline_exceeded += 1
+                except RequestShed:
+                    shed += 1
+                except Exception:  # nclint: disable=swallowed-exception -- tallied: the per-type breakdown lives in the engine's typed counters
                     failed += 1
             wall = time.perf_counter() - t0
             stats = engine.report()
@@ -351,8 +468,13 @@ def _run(args, telemetry):
         report.update(stats)
         report.update(
             wall_s=wall,
-            pairs_per_s=(len(requests) - failed) / wall,
+            pairs_per_s=ok / wall,
             failed=failed,
+            shed=shed,
+            deadline_exceeded=deadline_exceeded,
+            unsubmitted=unsubmitted,
+            admission_retries=tally["admission_retries"],
+            preempted=guard.requested,
         )
 
     text = json.dumps(report, indent=2, sort_keys=True)

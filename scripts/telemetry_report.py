@@ -1,8 +1,12 @@
 """Render a telemetry JSONL event log into per-surface summary tables.
 
-Consumes the ``events.jsonl`` a ``--telemetry DIR`` run writes
+Consumes the event log(s) a ``--telemetry DIR`` run writes
 (``scripts/train.py``, ``scripts/serve.py``, ``bench.py`` — one schema,
-`ncnet_tpu.telemetry.export`) and prints:
+`ncnet_tpu.telemetry.export`). A run dir may hold the legacy
+single-process ``events.jsonl`` OR per-process ``events_proc<P>.jsonl``
+files (multihost runs share one dir); both layouts are globbed, spans
+aggregate across all processes, and metric names are tagged
+``{proc=P}`` when more than one log contributes. Prints:
 
   * a **span table** per surface (the first path segment: ``step``,
     ``serve``, ``eval``, ``checkpoint``, ``features``): count, total
@@ -27,8 +31,36 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from ncnet_tpu.telemetry.export import EVENTS_NAME, read_events  # noqa: E402
+from ncnet_tpu.telemetry.export import find_event_logs, read_events  # noqa: E402
 from ncnet_tpu.telemetry.registry import percentiles  # noqa: E402
+
+
+def load_events(path):
+    """All events for ``path``: a JSONL file, or a run dir holding the
+    legacy ``events.jsonl`` and/or per-process ``events_proc<P>.jsonl``
+    logs. Multi-log runs get each event tagged with its log's
+    process index (the meta record's, falling back to file order) so
+    `final_metrics` can keep per-process values apart."""
+    if not os.path.isdir(path):
+        return read_events(path)
+    logs = find_event_logs(path)
+    if not logs:
+        raise FileNotFoundError(
+            f"{path}: no events.jsonl or events_proc*.jsonl found"
+        )
+    events = []
+    for i, log in enumerate(logs):
+        chunk = read_events(log)
+        proc = i
+        for e in chunk:
+            if e.get("type") == "meta" and "process_index" in e:
+                proc = int(e["process_index"])
+                break
+        if len(logs) > 1:
+            for e in chunk:
+                e.setdefault("proc", proc)
+        events.extend(chunk)
+    return events
 
 
 def aggregate_spans(events):
@@ -67,11 +99,17 @@ def aggregate_spans(events):
 
 
 def final_metrics(events):
-    """Last metric record per name (the stop()-time snapshot wins)."""
+    """Last metric record per name (the stop()-time snapshot wins).
+    Events carrying a ``proc`` tag (multi-log runs — see `load_events`)
+    keep one final value PER process, keyed ``name{proc=P}``, so two
+    hosts' counters never last-wins-clobber each other."""
     out = {}
     for e in events:
         if e.get("type") == "metric":
-            out[e["name"]] = e
+            name = e["name"]
+            if "proc" in e:
+                name = f"{name}{{proc={e['proc']}}}"
+            out[name] = e
     return out
 
 
@@ -163,9 +201,7 @@ def render(events):
 
 def report(path):
     """Machine-readable report dict for a log path (file or run dir)."""
-    if os.path.isdir(path):
-        path = os.path.join(path, EVENTS_NAME)
-    events = read_events(path)
+    events = load_events(path)
     return {
         "events": len(events),
         "spans": aggregate_spans(events),
@@ -177,16 +213,14 @@ def main(argv=None):
     p = argparse.ArgumentParser(
         description="render a telemetry events.jsonl into summary tables"
     )
-    p.add_argument("path", help="run dir (containing events.jsonl) or a "
-                                "JSONL file")
+    p.add_argument("path", help="run dir (containing events.jsonl or "
+                                "events_proc<P>.jsonl logs) or a JSONL "
+                                "file")
     p.add_argument("--json", action="store_true",
                    help="emit the aggregation as JSON instead of tables")
     args = p.parse_args(argv)
 
-    path = args.path
-    if os.path.isdir(path):
-        path = os.path.join(path, EVENTS_NAME)
-    events = read_events(path)
+    events = load_events(args.path)
     if args.json:
         print(json.dumps(
             {

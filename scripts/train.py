@@ -148,8 +148,8 @@ def main():
                         "trace small)")
     p.add_argument("--telemetry", type=str, default="", metavar="DIR",
                    help="write a telemetry run under DIR "
-                        "(ncnet_tpu.telemetry): a durable events.jsonl "
-                        "span/metric log plus a metrics.prom Prometheus "
+                        "(ncnet_tpu.telemetry): a durable per-process "
+                        "events_proc<P>.jsonl span/metric log plus a .prom "
                         "snapshot at exit; render with "
                         "scripts/telemetry_report.py DIR")
     p.add_argument("--multihost", action="store_true",

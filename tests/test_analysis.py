@@ -626,6 +626,90 @@ def test_wall_clock_suppression_with_reason():
     assert rule_ids(src) == []
 
 
+# --- swallowed-exception ----------------------------------------------------
+
+
+BAD_SWALLOW_BARE = """
+def load(path):
+    try:
+        return open(path).read()
+    except:
+        pass
+"""
+
+BAD_SWALLOW_BROAD_UNUSED = """
+def load(path):
+    try:
+        return open(path).read()
+    except Exception as exc:
+        return None
+"""
+
+BAD_SWALLOW_TUPLE = """
+def load(path):
+    try:
+        return open(path).read()
+    except (ValueError, Exception):
+        return None
+"""
+
+GOOD_SWALLOW_RERAISES = """
+def load(path):
+    try:
+        return open(path).read()
+    except Exception:
+        raise RuntimeError(path)
+"""
+
+GOOD_SWALLOW_USES_NAME = """
+def load(path, log):
+    try:
+        return open(path).read()
+    except Exception as exc:
+        log.warning("load failed: %s", exc)
+        return None
+"""
+
+GOOD_SWALLOW_NARROW = """
+def load(path):
+    try:
+        return open(path).read()
+    except FileNotFoundError:
+        return None
+"""
+
+
+def test_swallowed_exception_bare_and_broad_flagged():
+    assert rule_ids(BAD_SWALLOW_BARE) == ["swallowed-exception"]
+    assert rule_ids(BAD_SWALLOW_BROAD_UNUSED) == ["swallowed-exception"]
+    base = BAD_SWALLOW_BROAD_UNUSED.replace("Exception as exc", "BaseException")
+    assert rule_ids(base) == ["swallowed-exception"]
+
+
+def test_swallowed_exception_tuple_containing_broad_flagged():
+    assert rule_ids(BAD_SWALLOW_TUPLE) == ["swallowed-exception"]
+
+
+def test_swallowed_exception_reraise_use_and_narrow_clean():
+    assert rule_ids(GOOD_SWALLOW_RERAISES) == []
+    assert rule_ids(GOOD_SWALLOW_USES_NAME) == []
+    assert rule_ids(GOOD_SWALLOW_NARROW) == []
+
+
+def test_swallowed_exception_exempts_tests():
+    assert rule_ids(BAD_SWALLOW_BARE, path="tests/test_x.py") == []
+
+
+def test_swallowed_exception_suppression_with_reason():
+    src = BAD_SWALLOW_BROAD_UNUSED.replace(
+        "except Exception as exc:",
+        "except Exception as exc:  "
+        "# nclint: disable=swallowed-exception -- best-effort probe; "
+        "absence of the file is the answer",
+    )
+    assert rule_ids(src) == []
+
+
 # --- suppressions -----------------------------------------------------------
 
 

@@ -507,19 +507,19 @@ def test_serve_cli_smoke(tmp_path):
     assert report["latency_p95_ms"] >= report["latency_p50_ms"]
 
     # the same run produced a renderable telemetry log (acceptance
-    # criterion: one --telemetry flag -> events.jsonl + metrics.prom
-    # that telemetry_report.py understands); report rendering runs
-    # in-process — it is jax-free by contract
-    from ncnet_tpu.telemetry.export import read_events
+    # criterion: one --telemetry flag -> a per-process event log +
+    # .prom snapshot that telemetry_report.py understands); report
+    # rendering runs in-process — it is jax-free by contract
+    from ncnet_tpu.telemetry.export import events_name, prom_name, read_events
     from scripts.telemetry_report import render, report as telem_report
 
-    assert (telem_dir / "events.jsonl").exists()
-    prom = (telem_dir / "metrics.prom").read_text()
+    assert (telem_dir / events_name(0)).exists()
+    prom = (telem_dir / prom_name(0)).read_text()
     assert "# TYPE serve_requests_completed_total counter" in prom
     assert "serve_requests_completed_total 2" in prom
     assert "# TYPE serve_request_latency_seconds histogram" in prom
 
-    events = read_events(str(telem_dir / "events.jsonl"))
+    events = read_events(str(telem_dir / events_name(0)))
     kinds = {e["type"] for e in events}
     assert {"meta", "span", "metric"} <= kinds
     agg = telem_report(str(telem_dir))

@@ -1,0 +1,662 @@
+"""SLO-aware serving resilience (PR 10): typed outcomes, admission
+control against the EWMA estimate, in-pipeline deadline drops at every
+stage, the overload -> pre-warmed degraded-program flip (bitwise the
+nc_topk band program's own output), stage supervision drills (killed
+prep worker, hung dispatch, crashed readout — ONLY in-flight requests
+fail, typed; the stage restarts; zero recompiles after), bounded drain
+("shutdown returned => every accepted future resolved exactly once"),
+the micro-batcher under a backwards-jumping clock, and the SIGTERM
+drain drill through scripts/serve.py."""
+
+import json
+import os
+import queue
+import signal
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import Future
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+from ncnet_tpu.resilience import faultinject
+from ncnet_tpu.resilience.signals import PreemptionGuard
+from ncnet_tpu.serve import (
+    AdmissionRejected,
+    DeadlineExceeded,
+    HysteresisController,
+    LatencyEstimator,
+    MicroBatcher,
+    RequestShed,
+    ServeEngine,
+    ServeResilienceError,
+    StageFailure,
+    Watchdog,
+    drain_on_preemption,
+    make_serve_match_step,
+    payload_spec,
+    run_supervised,
+)
+from ncnet_tpu.serve.batcher import Request
+
+REPO = Path(__file__).resolve().parent.parent
+
+TINY = ImMatchNetConfig(ncons_kernel_sizes=(3,), ncons_channels=(1,))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.clear()
+    yield
+    faultinject.clear()
+
+
+def _toy_engine(**kw):
+    params = {"w": jnp.asarray(3.0, jnp.float32)}
+
+    def apply(p, batch):
+        return {"y": batch["x"] * p["w"]}
+
+    return ServeEngine(apply, params, **kw)
+
+
+def _toy_payload(n, fill):
+    return {"x": np.full((n,), fill, np.float32)}
+
+
+def _invariant(stats):
+    """The exactly-once ledger: every accepted request lands in exactly
+    one outcome counter."""
+    assert stats["submitted"] == (
+        stats["completed"] + stats["failed"] + stats["shed"]
+        + stats["deadline_exceeded"]
+    )
+
+
+# ----------------------------------------------------------------------
+# the typed-outcome taxonomy (what callers branch on)
+
+
+def test_exception_taxonomy():
+    shed = RequestShed("m", reason="admission", retry_after_s=0.5)
+    ddl = DeadlineExceeded("m", stage="readout", deadline_s=1.0)
+    rej = AdmissionRejected("m", retry_after_s=0.1)
+    hang = StageFailure("dispatch", "no heartbeat", hang=True)
+    for exc in (shed, ddl, rej, hang):
+        assert isinstance(exc, ServeResilienceError)
+        assert isinstance(exc, RuntimeError)
+    assert isinstance(ddl, RequestShed) and ddl.reason == "deadline"
+    assert ddl.stage == "readout"
+    # pre-PR-10 backpressure handlers catch queue.Full: must keep working
+    assert isinstance(rej, queue.Full)
+    assert rej.retry_after_s == 0.1
+    assert hang.stage == "dispatch" and hang.hang
+    assert "hang" in str(hang)
+    assert not StageFailure("prep", "boom").hang
+
+
+# ----------------------------------------------------------------------
+# admission control primitives
+
+
+def test_latency_estimator_ewma_and_fallback():
+    est = LatencyEstimator(alpha=0.5)
+    assert est.estimate("A") is None  # admit blind before any sample
+    est.observe("A", 1.0)
+    assert est.estimate("A") == 1.0
+    est.observe("A", 3.0)
+    assert est.estimate("A") == pytest.approx(2.0)  # 1 + .5*(3-1)
+    # unknown key falls back to the global EWMA, never None after a sample
+    assert est.estimate("B") == pytest.approx(2.0)
+    assert est.estimate() == pytest.approx(2.0)
+    with pytest.raises(ValueError):
+        LatencyEstimator(alpha=0.0)
+
+
+def test_hysteresis_controller_dwell_and_dead_band():
+    c = HysteresisController(high=0.75, low=0.25, up_count=2, down_count=2)
+    assert not c.update(0.9)  # one high reading is not enough
+    assert c.update(0.5) is False  # dead band resets the streak
+    assert not c.update(0.9)
+    assert c.update(0.9) is True  # 2 consecutive highs: flip up
+    assert c.flips == 1
+    assert c.update(0.1) is True  # one low reading is not enough
+    assert c.update(0.5) is True  # dead band keeps the mode (the point)
+    c.update(0.1)
+    assert c.update(0.1) is False  # 2 consecutive lows: flip back
+    assert c.flips == 2
+    assert c.last_pressure == 0.1
+    with pytest.raises(ValueError):
+        HysteresisController(high=0.2, low=0.5)
+    with pytest.raises(ValueError):
+        HysteresisController(up_count=0)
+
+
+def test_run_supervised_restarts_and_stopping():
+    crashes = []
+    state = {"n": 0}
+
+    def loop():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise RuntimeError(f"crash {state['n']}")
+
+    run_supervised(loop, on_crash=crashes.append)
+    assert state["n"] == 3 and len(crashes) == 2  # restarted twice, done
+
+    state["n"] = 0
+
+    def always_crash():
+        state["n"] += 1
+        raise RuntimeError("boom")
+
+    run_supervised(
+        always_crash, on_crash=crashes.append,
+        stopping=lambda: state["n"] >= 2,
+    )
+    assert state["n"] == 2  # stopping() short-circuits the restart
+
+
+def test_watchdog_fires_only_when_busy_and_stale():
+    hangs = []
+    busy = {"v": False}
+    dog = Watchdog(
+        0.05, beat_fn=lambda: 0.0, busy_fn=lambda: busy["v"],
+        on_hang=lambda: hangs.append(time.monotonic()),
+        clock=time.monotonic,
+    ).start()
+    try:
+        time.sleep(0.2)
+        assert hangs == []  # stale beat but idle: not a hang
+        busy["v"] = True
+        deadline = time.monotonic() + 5.0
+        while not hangs and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert hangs
+    finally:
+        dog.stop(join_timeout=5.0)
+    with pytest.raises(ValueError):
+        Watchdog(0.0, beat_fn=lambda: 0, busy_fn=lambda: 0,
+                 on_hang=lambda: None)
+
+
+# ----------------------------------------------------------------------
+# admission control + deadlines on the engine
+
+
+def test_admission_shed_on_primed_estimate():
+    with _toy_engine(max_batch=2, max_wait=0.005) as eng:
+        eng.warmup([("A", payload_spec(_toy_payload(3, 0.0)))])
+        eng.estimator.observe("A", 10.0)  # "a batch takes 10 s"
+        fut = eng.submit(
+            key="A", payload=_toy_payload(3, 1.0), deadline_s=0.05
+        )
+        assert fut.done()  # shed at admission: no queue slot occupied
+        with pytest.raises(RequestShed) as ei:
+            fut.result()
+        exc = ei.value
+        assert exc.reason == "admission"
+        assert not isinstance(exc, DeadlineExceeded)
+        assert exc.retry_after_s == pytest.approx(10.0)
+        assert exc.estimated_s > 10.0  # max_wait + est * margin
+        # a deadline the estimate CAN meet is admitted and served
+        ok = eng.submit(
+            key="A", payload=_toy_payload(3, 2.0), deadline_s=30.0
+        )
+        np.testing.assert_array_equal(
+            ok.result(timeout=10)["y"], np.full((3,), 6.0, np.float32)
+        )
+        stats = eng.report()
+    assert stats["shed"] == 1 and stats["completed"] == 1
+    assert stats["deadline_exceeded"] == 0
+    _invariant(stats)
+
+
+def test_admission_admits_blind_before_first_observation():
+    with _toy_engine(max_batch=2, max_wait=0.005) as eng:
+        eng.warmup([("A", payload_spec(_toy_payload(3, 0.0)))])
+        # no EWMA sample yet: even a tight deadline is admitted rather
+        # than shed on a guess (and the toy pipeline meets it)
+        fut = eng.submit(
+            key="A", payload=_toy_payload(3, 1.0), deadline_s=30.0
+        )
+        fut.result(timeout=10)
+        stats = eng.report()
+    assert stats["shed"] == 0 and stats["completed"] == 1
+
+
+@pytest.mark.parametrize(
+    "point,stage",
+    [
+        ("serve.request", "prep"),
+        ("serve.dispatch.hang", "dispatch"),
+        ("serve.readout.delay", "readout"),
+    ],
+)
+def test_deadline_expires_in_pipeline(point, stage):
+    """An injected stage delay outlives the request's budget: the request
+    resolves with DeadlineExceeded naming the stage that dropped it (and
+    never occupies a device slot past its deadline)."""
+    faultinject.inject(point, "delay", arg=0.4, at=1)
+    with _toy_engine(max_batch=1, host_workers=1) as eng:
+        eng.warmup([("A", payload_spec(_toy_payload(3, 0.0)))])
+        if stage == "prep":
+            # the delay wedges the single worker INSIDE r1's prep; r2's
+            # budget expires while queued behind it
+            r1 = eng.submit(key="A", payload=_toy_payload(3, 0.0))
+            victim = eng.submit(
+                key="A", payload=_toy_payload(3, 1.0), deadline_s=0.05
+            )
+            r1.result(timeout=10)
+        else:
+            victim = eng.submit(
+                key="A", payload=_toy_payload(3, 1.0), deadline_s=0.05
+            )
+        with pytest.raises(DeadlineExceeded) as ei:
+            victim.result(timeout=10)
+        assert ei.value.stage == stage
+        stats = eng.report()
+    assert stats["deadline_exceeded"] == 1
+    assert stats["failed"] == 0  # a deadline drop is not a failure
+    _invariant(stats)
+
+
+def test_admission_rejected_typed_with_retry_hint():
+    faultinject.inject("serve.request", "delay", arg=0.4)
+    eng = _toy_engine(
+        max_batch=2, max_wait=0.005, queue_limit=1, host_workers=1
+    )
+    try:
+        accepted, rejected = [], None
+        for i in range(4):  # 1 in-flight + 1 queued: must refuse by #4
+            try:
+                accepted.append(eng.submit(
+                    key="A", payload=_toy_payload(3, float(i)), timeout=0
+                ))
+            except queue.Full as exc:  # the pre-PR-10 handler still works
+                rejected = exc
+                break
+        assert isinstance(rejected, AdmissionRejected)
+        assert rejected.retry_after_s is not None
+        assert "queue full" in str(rejected)
+    finally:
+        faultinject.clear()
+        eng.close()
+    for f in accepted:
+        f.result(timeout=10)  # every ACCEPTED future still resolves
+    assert eng.report()["admission_rejected"] >= 1
+    _invariant(eng.report())
+
+
+# ----------------------------------------------------------------------
+# overload degradation
+
+
+def _forced_controller():
+    # every pressure reading (>= 0) is "overload": flips on the dispatch
+    # loop's first observation — degradation without having to race a
+    # real queue build-up
+    return HysteresisController(high=0.0, low=-1.0, up_count=1)
+
+
+def test_degraded_flip_serves_degraded_program_toy():
+    params = {"w": jnp.asarray(3.0, jnp.float32)}
+
+    def dense(p, batch):
+        return {"y": batch["x"] * p["w"]}
+
+    def degraded(p, batch):
+        return {"y": batch["x"] + p["w"]}
+
+    with ServeEngine(
+        dense, params, max_batch=1,
+        degraded_apply_fn=degraded, degrade_controller=_forced_controller(),
+    ) as eng:
+        eng.warmup([("A", payload_spec(_toy_payload(3, 0.0)))])
+        warm = eng.compile_count
+        assert warm == 2  # both variants pre-warmed at bs 1
+        fut = eng.submit(key="A", payload=_toy_payload(3, 2.0))
+        np.testing.assert_array_equal(
+            fut.result(timeout=10)["y"],
+            np.full((3,), 5.0, np.float32),  # x + w: the DEGRADED program
+        )
+        stats = eng.report()
+        assert eng.compile_count == warm  # the flip compiled NOTHING
+    assert stats["degraded_mode"] is True
+    assert stats["degraded_batches"] == 1
+    assert stats["degrade_flips"] >= 1  # the flip event is counted
+    assert stats["recompiles_after_warmup"] == 0
+    # the flip/counter state is scrapeable from the metrics registry
+    assert eng.metrics.get("serve_degrade_flips_total").value >= 1
+
+
+def test_no_degradation_without_pressure():
+    params = {"w": jnp.asarray(3.0, jnp.float32)}
+
+    def dense(p, batch):
+        return {"y": batch["x"] * p["w"]}
+
+    def degraded(p, batch):
+        return {"y": batch["x"] + p["w"]}
+
+    with ServeEngine(
+        dense, params, max_batch=1, degraded_apply_fn=degraded,
+    ) as eng:  # default controller: idle traffic never reaches high water
+        eng.warmup([("A", payload_spec(_toy_payload(3, 0.0)))])
+        fut = eng.submit(key="A", payload=_toy_payload(3, 2.0))
+        np.testing.assert_array_equal(
+            fut.result(timeout=10)["y"],
+            np.full((3,), 6.0, np.float32),  # x * w: still the dense one
+        )
+        stats = eng.report()
+    assert stats["degraded_mode"] is False
+    assert stats["degraded_batches"] == 0 and stats["degrade_flips"] == 0
+
+
+def test_degraded_flip_is_bitwise_the_prewarmed_band_program():
+    """Under forced overload the engine serves the real model's nc_topk
+    band program, and the served result is BITWISE that program's own
+    output — the flip changes which pre-warmed executable runs, nothing
+    about how it runs (the patch16 trunk keeps the 2 traces cheap)."""
+    cfg = TINY.replace(feature_extraction_cnn="patch16")  # dense NC
+    band_cfg = cfg.replace(nc_topk=8)
+    params = init_immatchnet(jax.random.PRNGKey(0), cfg)
+    dense_fn = make_serve_match_step(cfg)
+    band_fn = make_serve_match_step(band_cfg)
+
+    rng = np.random.RandomState(3)
+    payload = {
+        "source_image": rng.rand(32, 48, 3).astype(np.float32),
+        "target_image": rng.rand(48, 32, 3).astype(np.float32),
+    }
+    expected = np.asarray(
+        jax.jit(band_fn)(params, {k: v[None] for k, v in payload.items()})
+        ["matches"]
+    )[0]
+
+    with ServeEngine(
+        dense_fn, params, max_batch=1,
+        degraded_apply_fn=band_fn, degrade_controller=_forced_controller(),
+    ) as eng:
+        eng.warmup([("K", payload_spec(payload))])
+        warm = eng.compile_count
+        fut = eng.submit(key="K", payload=payload)
+        got = fut.result(timeout=120)["matches"]
+        stats = eng.report()
+        assert eng.compile_count == warm
+    np.testing.assert_array_equal(got, expected)
+    assert stats["degraded_batches"] == 1
+    assert stats["recompiles_after_warmup"] == 0
+
+
+# ----------------------------------------------------------------------
+# supervision drills: a stage dies, ONLY in-flight requests fail (typed),
+# the stage restarts, the warm compile cache survives
+
+
+def test_prep_worker_crash_drill():
+    faultinject.inject("serve.worker.crash", "crash", at=2)
+    with _toy_engine(max_batch=2, max_wait=0.01, host_workers=1) as eng:
+        eng.warmup([("A", payload_spec(_toy_payload(3, 0.0)))])
+        warm = eng.compile_count
+        futs = [
+            eng.submit(key="A", payload=_toy_payload(3, float(i)))
+            for i in range(3)
+        ]
+        with pytest.raises(StageFailure) as ei:
+            futs[1].result(timeout=10)  # the in-flight one, and ONLY it
+        assert ei.value.stage == "prep" and not ei.value.hang
+        for i in (0, 2):  # before and AFTER the restart: served warm
+            np.testing.assert_array_equal(
+                futs[i].result(timeout=10)["y"],
+                np.full((3,), 3.0 * i, np.float32),
+            )
+        stats = eng.report()
+        assert eng.compile_count == warm
+    assert stats["stage_restarts"]["prep"] == 1
+    assert stats["failed"] == 1 and stats["completed"] == 2
+    assert stats["recompiles_after_warmup"] == 0
+    _invariant(stats)
+
+
+def test_dispatch_hang_drill_watchdog_recovers():
+    """A wedged dispatch (injected 3 s stall, unkillable in Python) is
+    detected by the heartbeat watchdog well before it wakes: the in-flight
+    batch fails typed (hang=True), a fresh dispatch thread takes over, and
+    the next request is served from the intact warm cache."""
+    faultinject.inject("serve.dispatch.hang", "delay", arg=3.0, at=1)
+    with _toy_engine(max_batch=1, hang_timeout=0.25) as eng:
+        eng.warmup([("A", payload_spec(_toy_payload(3, 0.0)))])
+        warm = eng.compile_count
+        t0 = time.monotonic()
+        victim = eng.submit(key="A", payload=_toy_payload(3, 1.0))
+        with pytest.raises(StageFailure) as ei:
+            victim.result(timeout=10)
+        assert time.monotonic() - t0 < 2.5  # recovered, not slept through
+        assert ei.value.stage == "dispatch" and ei.value.hang
+        fut = eng.submit(key="A", payload=_toy_payload(3, 2.0))
+        np.testing.assert_array_equal(
+            fut.result(timeout=10)["y"], np.full((3,), 6.0, np.float32)
+        )
+        stats = eng.report()
+        assert eng.compile_count == warm
+    assert stats["dispatch_hangs"] == 1
+    assert stats["stage_restarts"]["dispatch"] == 1
+    assert stats["failed"] == 1 and stats["completed"] == 1
+    assert stats["recompiles_after_warmup"] == 0
+    _invariant(stats)
+
+
+def test_readout_crash_drill():
+    faultinject.inject("serve.readout.delay", "crash", at=1)
+    with _toy_engine(max_batch=1) as eng:
+        eng.warmup([("A", payload_spec(_toy_payload(3, 0.0)))])
+        victim = eng.submit(key="A", payload=_toy_payload(3, 1.0))
+        with pytest.raises(StageFailure) as ei:
+            victim.result(timeout=10)
+        assert ei.value.stage == "readout"
+        fut = eng.submit(key="A", payload=_toy_payload(3, 2.0))
+        np.testing.assert_array_equal(
+            fut.result(timeout=10)["y"], np.full((3,), 6.0, np.float32)
+        )
+        stats = eng.report()
+    assert stats["stage_restarts"]["readout"] == 1
+    assert stats["recompiles_after_warmup"] == 0
+    _invariant(stats)
+
+
+# ----------------------------------------------------------------------
+# drain: shutdown returned => every accepted future resolved exactly once
+
+
+def test_bounded_shutdown_resolves_every_future_exactly_once():
+    faultinject.inject("serve.request", "delay", arg=0.2)  # every request
+    eng = _toy_engine(max_batch=2, max_wait=0.005, host_workers=1)
+    eng.warmup([("A", payload_spec(_toy_payload(3, 0.0)))])
+    settled = []
+    futs = [
+        eng.submit(key="A", payload=_toy_payload(3, float(i)))
+        for i in range(6)
+    ]
+    for f in futs:
+        f.add_done_callback(settled.append)
+    # ~1.2 s of prep left; the drain budget covers a fraction of it
+    eng.shutdown(timeout=0.4)
+    assert all(f.done() for f in futs)
+    assert len(settled) == 6  # each settled exactly once
+    drained = 0
+    for f in futs:
+        exc = f.exception()
+        if exc is not None:
+            assert isinstance(exc, RequestShed) and exc.reason == "drain"
+            drained += 1
+    assert drained >= 1  # the budget really did expire on stragglers
+    stats = eng.report()
+    _invariant(stats)
+    assert stats["shed"] == drained
+    eng.shutdown(timeout=0.4)  # idempotent, returns promptly
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.submit(key="A", payload=_toy_payload(3, 0.0))
+
+
+def test_concurrent_shutdown_blocks_until_drained():
+    """A second shutdown() must not return while the first is still
+    draining — callers use "shutdown returned" as "my futures resolved"
+    (scripts/serve.py tallies right after engine.drain())."""
+    faultinject.inject("serve.request", "delay", arg=0.3)
+    eng = _toy_engine(max_batch=2, max_wait=0.005, host_workers=1)
+    eng.warmup([("A", payload_spec(_toy_payload(3, 0.0)))])
+    futs = [
+        eng.submit(key="A", payload=_toy_payload(3, float(i)))
+        for i in range(3)
+    ]
+    first = threading.Thread(target=eng.shutdown)  # unbounded drain
+    first.start()
+    time.sleep(0.05)  # the first owns the drain by now
+    eng.shutdown()  # the follower: must block until the drain finishes
+    assert all(f.done() for f in futs)
+    first.join(timeout=10)
+    for f in futs:
+        f.result(timeout=0)  # unbounded drain: all completed
+    _invariant(eng.report())
+
+
+def test_drain_on_preemption_programmatic_trigger():
+    guard = PreemptionGuard()  # .request() stands in for SIGTERM
+    eng = _toy_engine(max_batch=2, max_wait=0.005)
+    eng.warmup([("A", payload_spec(_toy_payload(3, 0.0)))])
+    watcher = drain_on_preemption(eng, guard, timeout=5.0, poll_s=0.01)
+    fut = eng.submit(key="A", payload=_toy_payload(3, 1.0))
+    fut.result(timeout=10)
+    guard.request()
+    watcher.join(timeout=10)
+    assert not watcher.is_alive()
+    assert eng.closed
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.submit(key="A", payload=_toy_payload(3, 0.0))
+    _invariant(eng.report())
+
+
+# ----------------------------------------------------------------------
+# micro-batcher under a backwards-jumping clock (NTP step / VM migration)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _req(key, i=0):
+    return Request(key, {"x": np.full((2,), i, np.float32)}, Future(), 0.0)
+
+
+def test_batcher_tolerates_backwards_clock():
+    clk = FakeClock(100.0)
+    mb = MicroBatcher(max_batch=4, max_wait=0.1, clock=clk)
+    mb.add(_req("A", 0))
+    mb.add(_req("A", 1))
+    clk.t = 50.0  # the clock STEPS BACKWARDS mid-wait
+    assert mb.ready() == []  # no early flush...
+    assert mb.pending() == 2  # ...and nothing lost
+    assert mb.next_deadline() is not None
+    clk.t = 100.05  # back past the jump: deadline stretched, not skipped
+    assert mb.ready() == []
+    clk.t = 100.2  # comfortably past t0 + max_wait (fp-safe margin)
+    (batch,) = mb.ready()
+    assert len(batch.requests) == 2 and batch.key == "A"
+    # cap flush and drain are clock-independent: they work at t < 0 too
+    clk.t = -7.0
+    assert all(mb.add(_req("B", i)) is None for i in range(3))
+    assert mb.add(_req("B", 3)) is not None
+    mb.add(_req("C", 0))
+    (leftover,) = mb.drain()
+    assert leftover.key == "C"
+    assert mb.pending() == 0
+
+
+# ----------------------------------------------------------------------
+# the SIGTERM drain drill through scripts/serve.py (the ops contract)
+
+
+def test_serve_cli_sigterm_drain_drill(tmp_path):
+    """SIGTERM mid-run: admission stops, the engine drains under
+    --drain-timeout, EVERY accepted future resolves (result or typed
+    shed), the accounting adds up, and the process exits 0 with its
+    report written."""
+    from PIL import Image
+
+    from ncnet_tpu.train.checkpoint import CheckpointData, save_checkpoint
+
+    cfg = TINY.replace(feature_extraction_cnn="patch16")
+    params = init_immatchnet(jax.random.PRNGKey(0), cfg)
+    ckpt = tmp_path / "tiny.msgpack"
+    save_checkpoint(
+        str(ckpt),
+        CheckpointData(config=cfg, params=params, opt_state=None, epoch=0),
+    )
+    imgdir = tmp_path / "imgs"
+    imgdir.mkdir()
+    rng = np.random.RandomState(0)
+    for i in range(2):  # one pair, repeated: a single warm bucket
+        Image.fromarray(
+            rng.randint(0, 255, (32, 32, 3), np.uint8)
+        ).save(imgdir / f"im{i}.png")
+
+    report_path = tmp_path / "report.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        # ~50 ms per prep x 400 requests: >> the post-warmup signal point
+        NCNET_FAULTS="serve.request=delay:0.05",
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable, str(REPO / "scripts" / "serve.py"),
+            "--checkpoint", str(ckpt),
+            "--images", str(imgdir),
+            "--image-size", "32",
+            "--concurrency", "2",
+            "--max-batch", "2",
+            "--max-wait-ms", "10",
+            "--repeat", "400",
+            "--drain-timeout", "10",
+            "--report", str(report_path),
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=str(REPO),
+    )
+    try:
+        # the warmup line is the serving-phase marker; readline blocks
+        # until the script prints it (compile time varies by machine)
+        while True:
+            line = proc.stdout.readline()
+            assert line, "serve.py exited before finishing warmup"
+            if line.startswith("warmup:"):
+                break
+        time.sleep(1.0)  # let some requests complete
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=180)
+    finally:
+        proc.kill()
+    assert proc.returncode == 0, err[-2000:]
+    report = json.loads(report_path.read_text())
+    assert report["preempted"] is True
+    assert report["unsubmitted"] > 0  # the signal landed mid-run
+    assert report["completed"] >= 1  # ...with traffic already served
+    # accepted futures all resolved, each into exactly one bin
+    assert report["submitted"] + report["unsubmitted"] == report["n_requests"]
+    assert report["submitted"] == (
+        report["completed"] + report["failed"] + report["shed"]
+        + report["deadline_exceeded"]
+    )
+    assert report["recompiles_after_warmup"] == 0

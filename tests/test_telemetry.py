@@ -22,10 +22,12 @@ from ncnet_tpu.resilience.faultinject import InjectedFault
 from ncnet_tpu.telemetry import session as telemetry_session
 from ncnet_tpu.telemetry import trace
 from ncnet_tpu.telemetry.export import (
-    EVENTS_NAME,
     PROM_NAME,
     JsonlWriter,
+    events_name,
+    find_event_logs,
     metric_events,
+    prom_name,
     read_events,
     write_prometheus,
 )
@@ -302,12 +304,16 @@ def test_session_round_trip_and_single_session_contract(tmp_path):
     telemetry_session.stop()
     telemetry_session.stop()  # idempotent
 
-    events = read_events(str(tmp_path / EVENTS_NAME))
+    # sessions write the per-process layout (events_proc<P>.jsonl) so
+    # multihost runs can share one --telemetry dir without clobbering
+    events = read_events(str(tmp_path / events_name(0)))
     kinds = [e["type"] for e in events]
     assert kinds[0] == "meta" and "span" in kinds and "metric" in kinds
+    assert events[0]["process_index"] == 0
     assert not trace.is_enabled()  # stop() disabled the tracer
-    prom = (tmp_path / PROM_NAME).read_text()
+    prom = (tmp_path / prom_name(0)).read_text()
     assert "pairs_total 3" in prom
+    assert find_event_logs(str(tmp_path)) == [str(tmp_path / events_name(0))]
 
 
 def test_report_self_time_math():
@@ -455,7 +461,7 @@ def test_train_loop_telemetry_end_to_end(tmp_path):
     finally:
         telemetry_session.stop()
 
-    events = read_events(str(telem / EVENTS_NAME))
+    events = read_events(str(telem / events_name(0)))
     span_paths = {e["path"] for e in events if e["type"] == "span"}
     # the step splits + the durable checkpoint span all recorded
     assert "step/data_wait" in span_paths
@@ -469,7 +475,7 @@ def test_train_loop_telemetry_end_to_end(tmp_path):
     assert metrics["train_mfu"]["value"] > 0  # analytic MFU gauge was set
     assert metrics["checkpoint_bytes_written_total"]["value"] > 0
 
-    prom = (telem / PROM_NAME).read_text()
+    prom = (telem / prom_name(0)).read_text()
     assert "# TYPE train_steps_total counter" in prom
     assert "# TYPE train_step_seconds histogram" in prom
     text = render(events)
