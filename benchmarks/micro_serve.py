@@ -34,10 +34,17 @@ controller may flip dispatch to under queue pressure (shrink
 `--queue-limit` to provoke it), reporting `degraded_batches` /
 `degrade_flips`.
 
+Fleet mode (PR 11): `--replicas N` serves the same workload through a
+`ServeFleet` of N device-pinned replicas (a CPU proxy mesh of N virtual
+devices is provisioned automatically); the JSON line grows
+`fleet_pairs_s` and per-replica occupancy — the fleet-scaling numbers
+PERF.md's round-11 entry records.
+
 Usage:
   python benchmarks/micro_serve.py [--pairs 32] [--image-size 96]
       [--concurrency 8] [--max-batch 8] [--nc-topk 0]
       [--deadline-ms 0] [--degrade -1] [--queue-limit 64]
+      [--replicas 0]
 """
 
 import argparse
@@ -100,7 +107,23 @@ def main():
                         "hysteresis controller may flip to under queue "
                         "pressure (-1 off); flips/degraded batches are "
                         "reported")
+    p.add_argument("--replicas", type=int, default=0,
+                   help="serve through a ServeFleet of N device-pinned "
+                        "replicas (0: single engine). On CPU this "
+                        "provisions an N-virtual-device proxy mesh; the "
+                        "JSON line grows fleet_pairs_s + per-replica "
+                        "occupancy")
     args = p.parse_args()
+
+    if args.replicas > 1 and "jax" not in sys.modules:
+        # CPU proxy mesh: one virtual device per replica, set before the
+        # backend reads XLA_FLAGS (no-op when the flag is already there)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{args.replicas}"
+            ).strip()
 
     import jax
 
@@ -181,16 +204,23 @@ def main():
             else None
         )
         deadline_s = args.deadline_ms / 1e3 if args.deadline_ms > 0 else None
-        with ServeEngine(
-            apply_fn,
-            params,
+        common = dict(
             max_batch=args.max_batch,
             max_wait=args.max_wait_ms / 1e3,
             host_workers=args.host_workers,
             prep_fn=prep,
             queue_limit=args.queue_limit,
             degraded_apply_fn=degraded_fn,
-        ) as engine:
+        )
+        if args.replicas > 0:
+            from ncnet_tpu.serve import ServeFleet
+
+            server = ServeFleet(
+                apply_fn, params, replicas=args.replicas, **common
+            )
+        else:
+            server = ServeEngine(apply_fn, params, **common)
+        with server as engine:
             seen = {}
             for pair in requests:
                 key, payload = prep(pair)
@@ -232,11 +262,47 @@ def main():
                     pass
             serve_wall = time.perf_counter() - t0
             stats = engine.report()
-            # the engine's OWN latency histogram is the percentile source
-            # now (report()'s latencies_s is a view of the same samples)
-            pct = engine.metrics.get(
-                "serve_request_latency_seconds"
-            ).percentiles()
+            if args.replicas > 0:
+                # fleet: roll the per-replica engine stats up to the
+                # totals the single-engine JSON line reports, keep the
+                # per-replica occupancy next to them, and pool the
+                # latency samples (one histogram per private registry)
+                from ncnet_tpu.telemetry.registry import percentiles
+
+                per = stats["per_replica"]
+                real = sum(r["real_samples"] for r in per.values())
+                padded = sum(r["padded_samples"] for r in per.values())
+                stats["batches"] = sum(r["batches"] for r in per.values())
+                # padded_samples counts TOTAL padded rows (engine's
+                # _mean_occupancy convention: real / padded)
+                stats["mean_occupancy"] = real / padded if padded else 0.0
+                stats["recompiles_after_warmup"] = sum(
+                    r["recompiles_after_warmup"] for r in per.values()
+                )
+                stats["degraded_batches"] = sum(
+                    r["degraded_batches"] for r in per.values()
+                )
+                stats["degrade_flips"] = sum(
+                    r["degrade_flips"] for r in per.values()
+                )
+                replica_occupancy = {
+                    str(rid): round(r["mean_occupancy"], 3)
+                    for rid, r in sorted(per.items())
+                }
+                samples = []
+                for eng in engine.engines().values():
+                    samples.extend(
+                        eng.metrics.get(
+                            "serve_request_latency_seconds"
+                        ).samples
+                    )
+                pct = percentiles(samples)
+            else:
+                # the engine's OWN latency histogram is the percentile
+                # source (report()'s latencies_s views the same samples)
+                pct = engine.metrics.get(
+                    "serve_request_latency_seconds"
+                ).percentiles()
 
     out = {
         "pairs": args.pairs,
@@ -254,6 +320,14 @@ def main():
         "serve_p99_ms": round(pct["p99"] * 1e3, 1),
         "seq_p50_ms": round(seq_hist.percentiles()["p50"] * 1e3, 1),
     }
+    if args.replicas > 0:
+        out.update({
+            "replicas": args.replicas,
+            "fleet_pairs_s": out["served_pairs_s"],
+            "replica_occupancy": replica_occupancy,
+            "requeued": stats["requeued"],
+            "replicas_down": stats["replicas_down"],
+        })
     if slo:
         # SLO mode: sheds are a tallied outcome, so report goodput
         # (requests that met their deadline) alongside raw throughput

@@ -629,6 +629,41 @@ def _build_serve():
     )
 
 
+def _build_serve_sharded():
+    import jax
+
+    from ncnet_tpu.parallel.mesh import make_batch_sharded_apply, make_mesh
+    from ncnet_tpu.serve.engine import (
+        SERVE_DONATE_ARGNUMS,
+        make_serve_match_step,
+    )
+
+    config = _audit_config()
+    params = _audit_params(config)
+    # mesh over whatever devices this process has (1 in plain CI, 8 on
+    # the virtual-device harness): the shard_map eqn and the donation
+    # plumbing the rules check are present either way, and the batch is
+    # sized to the mesh so the leading dim always divides
+    mesh = make_mesh()
+    fn = jax.jit(
+        make_batch_sharded_apply(make_serve_match_step(config), mesh),
+        donate_argnums=SERVE_DONATE_ARGNUMS,
+    )
+    rng = np.random.default_rng(0)
+    img = rng.standard_normal(
+        (mesh.size, _IMAGE_SIDE, _IMAGE_SIDE, 3)
+    ).astype(np.float32)
+    batch = {"source_image": img, "target_image": img.copy()}
+    return BuiltProgram(
+        fn=fn,
+        args=(params, batch),
+        donate_expect={
+            argnum: "single-use padded request batch (mesh-sharded)"
+            for argnum in SERVE_DONATE_ARGNUMS
+        },
+    )
+
+
 def _build_eval_match():
     import jax
 
@@ -671,6 +706,11 @@ PROGRAMS: Dict[str, ProgramSpec] = {
             "serve/bucket",
             "serving engine bucket program (the warmup-compiled apply)",
             _build_serve,
+        ),
+        ProgramSpec(
+            "serve/sharded",
+            "batch-axis shard_map variant of the serving bucket program",
+            _build_serve_sharded,
         ),
         ProgramSpec(
             "eval/match",

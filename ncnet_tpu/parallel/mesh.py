@@ -200,6 +200,38 @@ def shard_batch(mesh, batch, axis="data"):
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 
+def make_batch_sharded_apply(apply_fn, mesh, axis="data"):
+    """Batch-axis `shard_map` variant of ``apply_fn(params, batch)``.
+
+    Params replicate (``P()``); every batch leaf and every output leaf
+    shards its leading (batch) dim along ``axis``. Inside the mapped fn
+    each device sees a ``global_batch / mesh.size`` slice and runs the
+    UNCHANGED single-device program on it, so the result is bitwise the
+    single-device program applied per shard and concatenated — the
+    serving engine's parity contract for its ``shard_mesh`` dispatch
+    path (tests/test_fleet.py pins it). The caller jits the returned fn
+    (donation plumbing included: the engine wraps it exactly like the
+    single-device apply, ``donate_argnums=(1,)``).
+
+    Requires every batch leaf's leading dim to divide by ``mesh.size``
+    (the engine only selects this variant for such padded sizes).
+    """
+    specs = (P(), P(axis))  # tree prefixes: params replicated, batch sharded
+    # API shim as parallel.spatial: jax >= 0.6 spells it jax.shard_map
+    # with check_vma; 0.4.x has the experimental module with check_rep.
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            apply_fn, mesh=mesh, in_specs=specs, out_specs=P(axis),
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        apply_fn, mesh=mesh, in_specs=specs, out_specs=P(axis),
+        check_rep=False,
+    )
+
+
 def replicate(mesh, tree):
     """Replicate a pytree (params, opt state) across the mesh.
 

@@ -37,6 +37,17 @@ the failures the recovery paths claim to survive:
                                 ``delay:<s>`` models a slow D2H/convert (the
                                 readout-deadline drill), ``crash`` a readout-
                                 stage crash
+  ``serve.replica.kill``        fleet dispatch (`ncnet_tpu.serve.fleet`): fires
+                                as a request is handed to its routed-to replica;
+                                ``crash`` kills THAT replica mid-load — the
+                                chaos drill: queued work must requeue onto
+                                survivors, in-flight batches fail typed
+                                `ReplicaDown`, survivors never recompile
+  ``serve.router.route``        every fleet routing decision
+                                (`ncnet_tpu.serve.router`): ``delay:<s>``
+                                models a slow placement path, ``crash`` fails
+                                the route (the outer future resolves typed —
+                                never raises into the caller)
   ``telemetry.write``           telemetry exporters (`ncnet_tpu.telemetry`):
                                 before each JSONL event-log flush, and mid-write
                                 of the ``.prom`` snapshot temp file — a crash

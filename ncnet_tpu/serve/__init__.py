@@ -26,6 +26,16 @@ degradation to a pre-warmed sparse program under overload, supervised
 stage restarts with a dispatch-hang watchdog (`StageFailure`), and
 deadline-bounded graceful drain (`drain_on_preemption` + the SIGTERM
 `PreemptionGuard`).
+
+Fleet layer (:mod:`ncnet_tpu.serve.fleet` + :mod:`ncnet_tpu.serve.router`):
+one device-pinned, warmed engine per chip behind a bucket-affinity
+best-ETA router; fleet-wide admission sheds only when NO replica can
+meet the budget; per-replica watchdog supervision with typed
+`ReplicaDown`, requeue of a dead replica's queued work onto survivors,
+and quarantine/rejoin with re-warmup. Engines also accept
+``shard_mesh=`` to run a bucket's batch sharded across the mesh via
+`parallel.mesh.make_batch_sharded_apply` (bitwise the single-device
+program per shard).
 """
 
 from ncnet_tpu.serve.batcher import MicroBatch, MicroBatcher, default_batch_sizes
@@ -37,11 +47,13 @@ from ncnet_tpu.serve.buckets import (
     request_buckets,
 )
 from ncnet_tpu.serve.engine import ServeEngine, make_serve_match_step, payload_spec
+from ncnet_tpu.serve.fleet import ServeFleet
 from ncnet_tpu.serve.resilience import (
     AdmissionRejected,
     DeadlineExceeded,
     HysteresisController,
     LatencyEstimator,
+    ReplicaDown,
     RequestShed,
     ServeResilienceError,
     StageFailure,
@@ -49,18 +61,23 @@ from ncnet_tpu.serve.resilience import (
     drain_on_preemption,
     run_supervised,
 )
+from ncnet_tpu.serve.router import FleetRouter, ReplicaView
 
 __all__ = [
     "AdmissionRejected",
     "BucketSpec",
     "DeadlineExceeded",
+    "FleetRouter",
     "HysteresisController",
     "LatencyEstimator",
     "MicroBatch",
     "MicroBatcher",
+    "ReplicaDown",
+    "ReplicaView",
     "RequestShed",
     "SCALE_FACTOR",
     "ServeEngine",
+    "ServeFleet",
     "ServeResilienceError",
     "StageFailure",
     "Watchdog",

@@ -138,6 +138,12 @@ class MicroBatcher:
         with self._lock:
             entry = self._groups.get(request.key)
             if entry is None:
+                if self.max_batch <= 1:
+                    # a fresh group already AT the cap (max_batch=1, the
+                    # fleet scaling benchmark's no-coalescing mode) must
+                    # flush now: parking it would let the next add grow
+                    # the group past batch_sizes[-1]
+                    return self._make_batch(request.key, [request])
                 self._groups[request.key] = (self._clock(), [request])
                 return None
             entry[1].append(request)
@@ -188,3 +194,10 @@ class MicroBatcher:
         """Number of queued (not yet flushed) requests."""
         with self._lock:
             return sum(len(reqs) for _, reqs in self._groups.values())
+
+    def keys(self):
+        """Bucket keys with queued (not yet flushed) requests — the
+        fleet router's bucket-affinity signal: a replica already holding
+        half a batch of key K is the cheapest place to send one more K."""
+        with self._lock:
+            return tuple(self._groups)

@@ -92,6 +92,7 @@ from ncnet_tpu.serve.resilience import (
     DeadlineExceeded,
     HysteresisController,
     LatencyEstimator,
+    ReplicaDown,
     RequestShed,
     StageFailure,
     Watchdog,
@@ -189,6 +190,33 @@ class ServeEngine:
     * ``clock`` — injectable monotonic clock shared with the batcher
       (tests pass a fake).
 
+    Fleet knobs (PR 11, `ncnet_tpu.serve.fleet`):
+
+    * ``device`` — pin the engine to ONE device: params are
+      ``device_put`` there at construction and every compiled program's
+      input specs carry that device's sharding, so co-resident engines
+      (one per device, the fleet topology) never cross-dispatch through
+      the process-global default device.
+    * ``shard_mesh`` / ``shard_min_batch`` — the batch-axis `shard_map`
+      dispatch variant (`parallel.mesh.make_batch_sharded_apply`): when
+      a padded batch is at least ``shard_min_batch`` rows AND divides
+      evenly over the mesh, dispatch runs the mesh-sharded program on
+      replicated params instead of the single-device one. Bitwise
+      contract: the sharded result equals the single-device program
+      applied per shard, concatenated. Mutually exclusive with
+      ``device`` (a pinned engine owns one chip; the sharded program
+      owns the mesh).
+    * ``replica_tag`` — the fleet's replica index: stamps this engine's
+      worker-thread spans with a ``replica`` tag
+      (`telemetry.trace.set_thread_tag`) so one fleet-wide report can
+      tell the replicas apart, and names the replica in `kill`'s typed
+      `ReplicaDown` outcomes.
+    * `kill()` — abrupt replica death (the chaos-drill verb): every
+      unresolved future fails with `ReplicaDown`, ``dispatched=True``
+      for batches already on the device (unrecoverable, typed — never
+      silent), ``False`` for queued-but-undispatched requests (the
+      fleet requeues exactly these onto survivors).
+
     Use as a context manager; `close` drains in-flight work, resolves
     every accepted future, and joins all threads; `shutdown(timeout=)`
     is the bounded-drain variant (leftover futures resolve with a typed
@@ -217,12 +245,36 @@ class ServeEngine:
         hang_timeout=None,
         estimator=None,
         clock=time.monotonic,
+        device=None,
+        shard_mesh=None,
+        shard_min_batch=0,
+        replica_tag=None,
     ):
         if compile_cache_dir is not None:
             from ncnet_tpu.utils.compile_cache import enable_compile_cache
 
             enable_compile_cache(compile_cache_dir)
+        if device is not None and shard_mesh is not None:
+            raise ValueError(
+                "device= pins the engine to one chip; shard_mesh= spans "
+                "the mesh — pick one"
+            )
+        self._device = device
+        self._shard_mesh = shard_mesh
+        self._shard_min_batch = max(int(shard_min_batch), 1)
+        self.replica_tag = replica_tag
+        # pin params to the engine's device NOW: a fleet builds one
+        # engine per device in one process, and placement via the
+        # process-global default device would cross-dispatch them all
+        # onto device 0
+        if device is not None:
+            params = jax.device_put(params, device)
         self._params = params
+        self._params_sharded = None
+        if shard_mesh is not None:
+            from ncnet_tpu.parallel.mesh import replicate
+
+            self._params_sharded = replicate(shard_mesh, params)
         self._prep_fn = prep_fn
         self._prep_retries = prep_retries
         self._retry_backoff = retry_backoff
@@ -255,6 +307,19 @@ class ServeEngine:
             return apply_fn(p, batch)
 
         self._jit = jax.jit(_counted_apply, donate_argnums=SERVE_DONATE_ARGNUMS)
+        self._jit_sharded = None
+        if shard_mesh is not None:
+            from ncnet_tpu.parallel.mesh import make_batch_sharded_apply
+
+            sharded_apply = make_batch_sharded_apply(apply_fn, shard_mesh)
+
+            def _counted_sharded(p, batch):
+                self._trace_count += 1
+                return sharded_apply(p, batch)
+
+            self._jit_sharded = jax.jit(
+                _counted_sharded, donate_argnums=SERVE_DONATE_ARGNUMS
+            )
         self._jit_degraded = None
         if degraded_apply_fn is not None:
 
@@ -274,9 +339,13 @@ class ServeEngine:
                 else None
             )
         )
-        self._compiled = {}  # (bucket key, padded size, degraded) -> exe
+        self._compiled = {}  # (key, padded size, degraded, sharded) -> exe
         self._compile_lock = threading.Lock()
         self._warm = False
+        # every (key, per-sample spec) warmup has seen: the fleet re-warms
+        # a rejoining replica from exactly this set, so
+        # recompiles_after_warmup == 0 holds across a kill + rejoin
+        self.warmed_specs = {}
 
         self._submit_q = queue.Queue(maxsize=queue_limit)
         self._batch_q = queue.Queue()
@@ -340,6 +409,14 @@ class ServeEngine:
         self._m_degraded_batches = m.counter(
             "serve_batches_degraded_total",
             "batches served by the degraded program",
+        )
+        self._m_sharded_batches = m.counter(
+            "serve_batches_sharded_total",
+            "batches served by the mesh-sharded (shard_map) program",
+        )
+        self._m_replica_down = m.counter(
+            "serve_replica_down_total",
+            "requests failed or requeued because this replica was killed",
         )
         self._m_flips = m.counter(
             "serve_degrade_flips_total",
@@ -426,19 +503,53 @@ class ServeEngine:
     # ------------------------------------------------------------------
     # compile management
 
-    def _specs(self, key, bs, pspec):
+    def _specs(self, key, bs, pspec, sharded=False):
         del key  # the bucket key is already encoded in the shapes
+        sharding = None
+        if sharded:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sharding = NamedSharding(self._shard_mesh, PartitionSpec("data"))
+        elif self._device is not None:
+            from jax.sharding import SingleDeviceSharding
+
+            # the pinning half of the contract: inputs compile AGAINST
+            # this device, so the executable can never be fed through
+            # another engine's placement
+            sharding = SingleDeviceSharding(self._device)
         return {
-            name: jax.ShapeDtypeStruct((bs,) + tuple(shape), dtype)
+            name: jax.ShapeDtypeStruct(
+                (bs,) + tuple(shape), dtype, sharding=sharding
+            )
             for name, (shape, dtype) in pspec.items()
         }
 
-    def _executable(self, key, bs, pspec, live, degraded=False):
-        ck = (key, bs, degraded)
+    def _shardable(self, pad_to):
+        """Whether a padded batch takes the mesh-sharded program: large
+        enough to span the mesh AND divides evenly over it (the batcher's
+        power-of-two pad sizes make every size >= mesh.size divide a
+        power-of-two mesh)."""
+        return (
+            self._jit_sharded is not None
+            and pad_to >= self._shard_min_batch
+            and pad_to % self._shard_mesh.size == 0
+        )
+
+    def _program_params(self, sharded):
+        return self._params_sharded if sharded else self._params
+
+    def _executable(self, key, bs, pspec, live, degraded=False,
+                    sharded=False):
+        ck = (key, bs, degraded, sharded)
         exe = self._compiled.get(ck)
         if exe is not None:
             return exe
-        jit = self._jit_degraded if degraded else self._jit
+        if sharded:
+            jit = self._jit_sharded
+        elif degraded:
+            jit = self._jit_degraded
+        else:
+            jit = self._jit
         if jit is None:
             raise ValueError(
                 "degraded dispatch requested but the engine has no "
@@ -450,7 +561,8 @@ class ServeEngine:
                 if live and self._warm:
                     self._m_recompiles.inc()
                 exe = jit.lower(
-                    self._params, self._specs(key, bs, pspec)
+                    self._program_params(sharded),
+                    self._specs(key, bs, pspec, sharded=sharded),
                 ).compile()
                 self._compiled[ck] = exe
         return exe
@@ -469,12 +581,16 @@ class ServeEngine:
         programs now cached.
         """
         for key, pspec in bucket_specs:
+            self.warmed_specs[key] = pspec
             for bs in self.batch_sizes:
                 self._executable(key, bs, pspec, live=False)
                 if self._jit_degraded is not None:
                     self._executable(
                         key, bs, pspec, live=False, degraded=True
                     )
+                if self._shardable(bs):
+                    self._executable(key, bs, pspec, live=False,
+                                     sharded=True)
         self._warm = True
         return len(self._compiled)
 
@@ -561,7 +677,14 @@ class ServeEngine:
 
     # -- prep stage ----------------------------------------------------
 
+    def _tag_thread(self):
+        # fleet telemetry: worker-thread spans carry the replica index so
+        # one merged report can tell the fleet's replicas apart
+        if self.replica_tag is not None:
+            trace.set_thread_tag("replica", self.replica_tag)
+
     def _prep_worker(self):
+        self._tag_thread()
         # single-slot in-flight ledger shared with the supervisor: when
         # the loop crashes, ONLY the request left here fails
         inflight = {}
@@ -613,12 +736,17 @@ class ServeEngine:
                 self._fail(fut, exc)
                 inflight.pop("fut", None)
                 continue
-            inflight.pop("fut", None)
+            # the future stays in the in-flight ledger until the request
+            # is safely parked in the batcher (or its batch enqueued): a
+            # crash in add/put then fails THIS request instead of losing
+            # it silently (double-settle is impossible — settling is
+            # InvalidStateError-guarded)
             batch = self._batcher.add(
                 Request(key, payload, fut, t_submit, deadline)
             )
             if batch is not None:  # the add filled a group to max_batch
                 self._batch_q.put(batch)
+            inflight.pop("fut", None)
 
     # -- dispatch stage ------------------------------------------------
 
@@ -631,6 +759,8 @@ class ServeEngine:
         self._dispatcher.start()
 
     def _dispatch_worker(self, gen):
+        self._tag_thread()
+
         def on_crash(exc):
             with self._gen_lock:
                 batch = self._inflight_dispatch.pop(gen, None)
@@ -743,6 +873,10 @@ class ServeEngine:
                 batch.key, live, pad_size(len(live), self.batch_sizes)
             )
         degraded = self._degraded_now()
+        # the sharded program is the LARGE-batch fast path; the degraded
+        # program is the overload fallback — under pressure the cheaper
+        # single-device band program wins
+        sharded = not degraded and self._shardable(batch.pad_to)
         try:
             reqs = batch.requests
             names = sorted(reqs[0].payload)
@@ -756,10 +890,12 @@ class ServeEngine:
                 stacked[name] = np.stack(arrs)
             exe = self._executable(
                 batch.key, batch.pad_to, payload_spec(reqs[0].payload),
-                live=True, degraded=degraded,
+                live=True, degraded=degraded, sharded=sharded,
             )
+            if sharded:
+                self._m_sharded_batches.inc()
             t_dispatch = self._clock()
-            out = exe(self._params, stacked)
+            out = exe(self._program_params(sharded), stacked)
             # start D2H immediately; the readout thread's np.asarray
             # then finds the bytes already on their way
             for leaf in jax.tree_util.tree_leaves(out):
@@ -796,6 +932,7 @@ class ServeEngine:
     # -- readout stage -------------------------------------------------
 
     def _readout_worker(self):
+        self._tag_thread()
         inflight = {}
 
         def on_crash(exc):
@@ -891,6 +1028,8 @@ class ServeEngine:
             self._m_shed.inc()
         else:
             self._m_failed.inc()
+            if isinstance(exc, ReplicaDown):
+                self._m_replica_down.inc()
 
     # ------------------------------------------------------------------
     # lifecycle / accounting
@@ -902,6 +1041,113 @@ class ServeEngine:
     @property
     def closed(self):
         return self._closed
+
+    # -- the fleet's view of one replica -------------------------------
+
+    @property
+    def heartbeat(self):
+        """Last dispatch-loop heartbeat on the engine clock — the fleet
+        watchdog's ``beat_fn`` (the internal hang watchdog reads the same
+        field)."""
+        return self._dispatch_beat
+
+    @property
+    def busy(self):
+        """True while a batch is on the device (the watchdog's
+        ``busy_fn``: an idle replica that stops beating is not hung)."""
+        return bool(self._inflight_dispatch)
+
+    @property
+    def max_wait(self):
+        return self._batcher.max_wait
+
+    @property
+    def max_batch(self):
+        return self._batcher.max_batch
+
+    def queued_work(self):
+        """Requests admitted but not yet dispatched — the router's
+        backlog signal for this replica's ETA."""
+        return (
+            self._submit_q.qsize()
+            + self._batcher.pending()
+            + self._batch_q.qsize()
+        )
+
+    def pending_bucket_keys(self):
+        """Bucket keys with half-filled micro-batches — the router's
+        bucket-affinity signal (one more same-key request completes a
+        batch instead of opening a new group elsewhere)."""
+        return self._batcher.keys()
+
+    def kill(self, reason="killed"):
+        """Abrupt replica death (the fleet chaos-drill verb; contrast
+        `shutdown`, the graceful drain). Admission stops immediately and
+        EVERY unresolved future fails with a typed `ReplicaDown`:
+        ``dispatched=True`` for requests whose batch was already on the
+        device (the result is lost with the replica — typed, never
+        silent), ``dispatched=False`` for queued-but-undispatched
+        requests, which the fleet requeues onto surviving replicas.
+        Worker threads are told to exit best-effort (they are daemons; a
+        real preemption would take the whole process). Idempotent."""
+        with self._close_lock:
+            if self._closed:
+                self._drained.wait()
+                return
+            self._closed = True
+        # supersede the dispatcher so an in-progress or wedged dispatch
+        # discards its work when it wakes (same mechanism as hang
+        # recovery, but no successor thread is started)
+        with self._gen_lock:
+            self._dispatch_gen += 1
+            dispatched = [
+                r.future
+                for b in self._inflight_dispatch.values()
+                for r in b.requests
+            ]
+            self._inflight_dispatch.clear()
+        # batches sitting in the readout queue were dispatched too: their
+        # device results die with the replica
+        while True:
+            try:
+                item = self._readout_q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _SENTINEL:
+                dispatched.extend(r.future for r in item[0].requests)
+        dispatched = set(dispatched)
+        self._stop_dispatch.set()
+        # drain the submit queue (frees slots for the worker sentinels;
+        # these futures are undispatched and already in the ledger)
+        while True:
+            try:
+                item = self._submit_q.get_nowait()
+            except queue.Empty:
+                break
+        for _ in self._workers:
+            try:
+                self._submit_q.put_nowait(_SENTINEL)
+            except queue.Full:  # worker races refilled it; daemons anyway
+                break
+        try:
+            self._readout_q.put_nowait(_SENTINEL)
+        except queue.Full:
+            pass
+        if self._watchdog is not None:
+            self._watchdog.stop(0)
+        tag = self.replica_tag if self.replica_tag is not None else "?"
+        with self._pending_lock:
+            leftovers = list(self._pending)
+        for fut in leftovers:
+            on_device = fut in dispatched
+            self._fail(fut, ReplicaDown(
+                f"replica {tag} {reason}: "
+                + ("in-flight batch lost with the replica" if on_device
+                   else "request was queued, eligible for requeue"),
+                replica=self.replica_tag,
+                dispatched=on_device,
+            ))
+        self._drained.set()
 
     def report(self):
         """Snapshot of serving stats: counts, mean batch occupancy,
@@ -920,6 +1166,8 @@ class ServeEngine:
             "real_samples": self._m_real.value,
             "padded_samples": self._m_padded.value,
             "recompiles_after_warmup": self._m_recompiles.value,
+            "sharded_batches": self._m_sharded_batches.value,
+            "replica_down": self._m_replica_down.value,
             "degraded_batches": self._m_degraded_batches.value,
             "degrade_flips": self._m_flips.value,
             "degraded_mode": self._degraded_now(),

@@ -1,0 +1,497 @@
+"""`ServeFleet`: one warmed `ServeEngine` per device, routed, supervised.
+
+PR 6-10 built a single-replica serving story: one engine, one device,
+typed outcomes, admission control, stage supervision. One process on a
+TPU host has 4-8 chips; this module scales the same contract across
+them without weakening it:
+
+* **Topology** — one `ServeEngine` per device, each PINNED to its chip
+  (``device=`` placement at construction; two engines in one process
+  never cross-dispatch) with its own `LatencyEstimator`, its own private
+  metrics registry (shared counters would merge per-replica totals into
+  one meaningless sum), and ``replica_tag`` telemetry so merged span
+  logs stay attributable.
+* **Routing** — `serve.router.FleetRouter`: best-ETA placement with
+  bucket affinity; fleet-wide admission sheds ONLY when no replica can
+  meet the budget.
+* **Supervision** — a per-replica `Watchdog` over the engine's dispatch
+  heartbeat declares a wedged replica dead (`kill_replica`); the fleet
+  then REQUEUES the dead replica's queued-but-undispatched requests onto
+  survivors, while in-flight dispatches fail with a typed `ReplicaDown`
+  (``dispatched=True``) — never silently. A killed replica is
+  QUARANTINED (removed from routing) until `rejoin` builds a fresh
+  engine on the same device and re-warms it from the fleet's recorded
+  bucket specs, so ``recompiles_after_warmup == 0`` holds per replica
+  even across a kill.
+* **Accounting** — every accepted future resolves exactly once, and the
+  fleet counters satisfy the identity (drilled in tests/test_fleet.py)::
+
+      submitted == completed + failed + shed + deadline_exceeded
+                   + requeued_then_completed
+
+Fault points: ``serve.replica.kill`` fires on every dispatch (arm
+``crash`` to kill the routed-to replica mid-load — the chaos drill);
+``serve.router.route`` fires on every routing decision.
+"""
+
+import queue
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+
+import jax
+
+from ncnet_tpu.resilience import faultinject
+from ncnet_tpu.resilience.faultinject import InjectedFault
+from ncnet_tpu.serve.engine import ServeEngine
+from ncnet_tpu.serve.resilience import (
+    DeadlineExceeded,
+    ReplicaDown,
+    RequestShed,
+    Watchdog,
+)
+from ncnet_tpu.serve.router import FleetRouter, ReplicaView
+from ncnet_tpu.telemetry.registry import MetricsRegistry
+
+_SENTINEL = object()
+
+
+class _Request:
+    """One fleet-level request: the caller's outer future plus what a
+    (re-)dispatch needs. ``requeued`` flips when a dead replica's queued
+    request moves to a survivor — its eventual success then counts as
+    ``requeued_then_completed``, keeping the accounting identity exact."""
+
+    __slots__ = ("future", "raw", "key", "payload", "deadline_abs",
+                 "requeued")
+
+    def __init__(self, raw, key, payload, deadline_abs):
+        self.future = Future()
+        self.raw = raw
+        self.key = key
+        self.payload = payload
+        self.deadline_abs = deadline_abs
+        self.requeued = False
+
+
+class _Replica:
+    __slots__ = ("engine", "watchdog", "device")
+
+    def __init__(self, engine, watchdog, device):
+        self.engine = engine
+        self.watchdog = watchdog
+        self.device = device
+
+
+class ServeFleet:
+    """A supervised fleet of device-pinned `ServeEngine` replicas behind
+    one `submit`.
+
+    ``replicas`` defaults to one per visible device; extra replicas wrap
+    around the device list (useful on the CPU-proxy mesh). Engine tuning
+    kwargs (``max_batch``, ``prep_fn``, ``batch_sizes``, ...) pass
+    through to every replica; ``device``/``registry``/``estimator``/
+    ``replica_tag`` are fleet-owned and cannot be overridden.
+
+    ``replica_hang_timeout`` arms one `Watchdog` per replica over the
+    engine's dispatch heartbeat; a hang kills + quarantines that replica
+    and survivors absorb its queued work. Leave None when latencies are
+    unbounded (e.g. first-compile-in-flight setups without warmup).
+    """
+
+    def __init__(self, apply_fn, params, *, replicas=None, devices=None,
+                 router=None, replica_hang_timeout=None,
+                 clock=time.monotonic, registry=None, **engine_kwargs):
+        for owned in ("device", "registry", "estimator", "replica_tag",
+                      "shard_mesh", "clock"):
+            if owned in engine_kwargs:
+                raise ValueError(
+                    f"{owned}= is fleet-owned, not a pass-through "
+                    "engine kwarg"
+                )
+        if devices is None:
+            devices = jax.devices()
+        if replicas is None:
+            replicas = len(devices)
+        if replicas < 1:
+            raise ValueError(f"need >= 1 replica, got {replicas}")
+        self._apply_fn = apply_fn
+        self._params = params
+        self._engine_kwargs = dict(engine_kwargs)
+        self._router = router if router is not None else FleetRouter()
+        self._hang_timeout = replica_hang_timeout
+        self._clock = clock
+        self._closed = False
+        self._close_lock = threading.Lock()
+
+        self._lock = threading.Lock()  # replica table + quarantine set
+        self._replicas = {}  # rid -> _Replica (healthy, routable)
+        self._quarantined = {}  # rid -> device (killed, awaiting rejoin)
+        self._warm_specs = {}  # key -> per-sample spec (rejoin re-warms)
+
+        self._pending = set()
+        self._pending_lock = threading.Lock()
+        self._requeue_q = queue.Queue()
+
+        self.metrics = registry if registry is not None else MetricsRegistry()
+        m = self.metrics
+        self._m_submitted = m.counter(
+            "fleet_requests_submitted_total",
+            "requests accepted by fleet submit()",
+        )
+        self._m_completed = m.counter(
+            "fleet_requests_completed_total",
+            "requests resolved with a result (never requeued)",
+        )
+        self._m_failed = m.counter(
+            "fleet_requests_failed_total",
+            "requests resolved with a non-shed exception",
+        )
+        self._m_shed = m.counter(
+            "fleet_requests_shed_total",
+            "requests shed (fleet admission, no live replica, or drain)",
+        )
+        self._m_deadline = m.counter(
+            "fleet_deadline_exceeded_total",
+            "requests whose deadline expired before completion",
+        )
+        self._m_requeued = m.counter(
+            "fleet_requests_requeued_total",
+            "queued requests moved off a dead replica onto a survivor",
+        )
+        self._m_requeued_completed = m.counter(
+            "fleet_requeued_completed_total",
+            "requeued requests that then resolved with a result",
+        )
+        self._m_replicas_down = m.counter(
+            "fleet_replicas_down_total",
+            "replica kills (chaos, watchdog hang, or explicit)",
+        )
+        self._m_rejoins = m.counter(
+            "fleet_rejoins_total", "quarantined replicas re-warmed back in"
+        )
+
+        for i in range(replicas):
+            self._start_replica(i, devices[i % len(devices)])
+
+        self._requeue_thread = threading.Thread(
+            target=self._requeue_loop, name="fleet-requeue", daemon=True
+        )
+        self._requeue_thread.start()
+
+    # -- replica lifecycle ---------------------------------------------
+
+    def _start_replica(self, rid, device):
+        engine = ServeEngine(
+            self._apply_fn, self._params,
+            device=device, replica_tag=rid, clock=self._clock,
+            **self._engine_kwargs,
+        )
+        watchdog = None
+        if self._hang_timeout is not None:
+            watchdog = Watchdog(
+                self._hang_timeout,
+                beat_fn=lambda e=engine: e.heartbeat,
+                busy_fn=lambda e=engine: e.busy,
+                on_hang=lambda r=rid: self.kill_replica(
+                    r, reason="dispatch heartbeat stalled"
+                ),
+                clock=self._clock,
+            ).start()
+        with self._lock:
+            self._replicas[rid] = _Replica(engine, watchdog, device)
+        return engine
+
+    def kill_replica(self, rid, reason="killed"):
+        """Declare replica ``rid`` dead: quarantine it (routing stops
+        immediately), then fail/requeue its pending work via
+        `ServeEngine.kill`. Safe from the watchdog thread and from a
+        dispatch that hit an injected fault; idempotent."""
+        with self._lock:
+            rep = self._replicas.pop(rid, None)
+            if rep is None:
+                return  # already quarantined (or never existed)
+            self._quarantined[rid] = rep.device
+        self._m_replicas_down.inc()
+        if rep.watchdog is not None:
+            rep.watchdog.stop(join_timeout=0)
+        # outside the lock: kill() resolves every pending inner future,
+        # and each resolution runs _on_inner_done on THIS thread
+        rep.engine.kill(reason=reason)
+
+    def rejoin(self, rid):
+        """Bring a quarantined replica back: a FRESH engine on the same
+        device, re-warmed over every bucket spec the fleet has seen, so
+        the rejoined replica serves with zero post-warmup compiles (the
+        kill took the old engine's executables with it; the fleet's
+        record of `warmup` specs is the durable copy). Returns the new
+        engine's compiled-program count."""
+        with self._lock:
+            if rid in self._replicas:
+                raise ValueError(f"replica {rid!r} is already healthy")
+            device = self._quarantined.pop(rid, None)
+        if device is None:
+            raise KeyError(f"no quarantined replica {rid!r}")
+        engine = self._start_replica(rid, device)
+        n = engine.warmup(list(self._warm_specs.items()))
+        self._m_rejoins.inc()
+        return n
+
+    def warmup(self, bucket_specs):
+        """AOT-warm every replica over ``bucket_specs`` (the
+        `ServeEngine.warmup` contract, fleet-wide) and RECORD the specs:
+        `rejoin` re-warms a replacement replica from this record."""
+        specs = list(bucket_specs)
+        for key, pspec in specs:
+            self._warm_specs[key] = pspec
+        total = 0
+        for rep in self._healthy():
+            total += rep.engine.warmup(specs)
+        return total
+
+    def _healthy(self):
+        with self._lock:
+            return list(self._replicas.values())
+
+    def _engine(self, rid):
+        with self._lock:
+            rep = self._replicas.get(rid)
+        return None if rep is None else rep.engine
+
+    def _views(self):
+        with self._lock:
+            items = list(self._replicas.items())
+        return [
+            ReplicaView(
+                rid,
+                estimator=rep.engine.estimator,
+                queued_fn=rep.engine.queued_work,
+                keys_fn=rep.engine.pending_bucket_keys,
+                max_wait=rep.engine.max_wait,
+                max_batch=rep.engine.max_batch,
+            )
+            for rid, rep in items
+        ]
+
+    # -- submit / dispatch ---------------------------------------------
+
+    def submit(self, raw=None, *, key=None, payload=None, deadline_s=None):
+        """Queue one request on the best replica; returns a Future.
+
+        The fleet analog of `ServeEngine.submit`: same raw-vs-
+        key/payload convention, same typed outcomes — plus `ReplicaDown`
+        (``dispatched=True``) when the replica holding a dispatched
+        batch dies. Routing failures resolve the RETURNED future (typed
+        `RequestShed`), they do not raise, so callers have exactly one
+        error channel."""
+        if self._closed:
+            raise RuntimeError("submit on a closed ServeFleet")
+        deadline_abs = (
+            None if deadline_s is None else self._clock() + deadline_s
+        )
+        record = _Request(raw, key, payload, deadline_abs)
+        with self._pending_lock:
+            self._pending.add(record)
+        self._m_submitted.inc()
+        self._route_and_dispatch(record)
+        return record.future
+
+    def _remaining(self, record):
+        if record.deadline_abs is None:
+            return None
+        return record.deadline_abs - self._clock()
+
+    def _route_and_dispatch(self, record):
+        remaining = self._remaining(record)
+        if remaining is not None and remaining <= 0:
+            self._settle_exc(record, DeadlineExceeded(
+                "deadline expired before placement",
+                stage="route", deadline_s=0.0,
+            ))
+            return
+        try:
+            view = self._router.route(
+                self._views(), key=record.key, deadline_s=remaining
+            )
+        except RequestShed as exc:
+            self._settle_exc(record, exc)
+            return
+        except Exception as exc:  # noqa: BLE001 — typed resolution boundary: the outer future must resolve
+            self._settle_exc(record, exc)
+            return
+        self._dispatch_to(view.replica, record)
+
+    def _dispatch_to(self, rid, record):
+        try:
+            faultinject.fire("serve.replica.kill")
+        except InjectedFault:
+            # the chaos drill: the routed-to replica dies under us —
+            # kill + quarantine it, then place this request on a
+            # survivor (or shed typed when none remain)
+            self.kill_replica(rid, reason="injected kill")
+            self._route_and_dispatch(record)
+            return
+        engine = self._engine(rid)
+        if engine is None:
+            self._route_and_dispatch(record)  # raced with a kill
+            return
+        try:
+            inner = engine.submit(
+                record.raw, key=record.key, payload=record.payload,
+                deadline_s=self._remaining(record),
+            )
+        except RuntimeError as exc:
+            # includes AdmissionRejected; a closed engine means the kill
+            # raced our routing decision — re-route, don't fail
+            if engine.closed:
+                self._route_and_dispatch(record)
+            else:
+                self._settle_exc(record, exc)
+            return
+        inner.add_done_callback(
+            lambda f, r=record: self._on_inner_done(r, f)
+        )
+
+    def _on_inner_done(self, record, inner):
+        exc = inner.exception()
+        if exc is None:
+            self._settle_result(record, inner.result())
+        elif (isinstance(exc, ReplicaDown) and not exc.dispatched
+              and not self._closed):
+            # queued-but-undispatched on a dead replica: move it to a
+            # survivor. Off-thread via the requeue queue — this callback
+            # runs inside the killer's kill() loop, which must not block
+            # on routing or a survivor's bounded submit queue.
+            if not record.requeued:
+                record.requeued = True
+                self._m_requeued.inc()
+            self._requeue_q.put(record)
+        else:
+            self._settle_exc(record, exc)
+
+    def _requeue_loop(self):
+        while True:
+            record = self._requeue_q.get()
+            if record is _SENTINEL:
+                return
+            try:
+                self._route_and_dispatch(record)
+            except Exception as exc:  # noqa: BLE001 — last-resort: the outer future must resolve
+                self._settle_exc(record, exc)
+
+    # -- exactly-once settlement ---------------------------------------
+
+    def _settle_result(self, record, result):
+        with self._pending_lock:
+            self._pending.discard(record)
+        try:
+            record.future.set_result(result)
+        except InvalidStateError:
+            return  # lost a settle race; the winner already counted
+        if record.requeued:
+            self._m_requeued_completed.inc()
+        else:
+            self._m_completed.inc()
+
+    def _settle_exc(self, record, exc):
+        with self._pending_lock:
+            self._pending.discard(record)
+        try:
+            record.future.set_exception(exc)
+        except InvalidStateError:
+            return
+        if isinstance(exc, DeadlineExceeded):
+            self._m_deadline.inc()
+        elif isinstance(exc, RequestShed):
+            self._m_shed.inc()
+        else:
+            self._m_failed.inc()
+
+    # -- lifecycle / introspection -------------------------------------
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def replica_ids(self):
+        with self._lock:
+            return sorted(self._replicas)
+
+    def quarantined_ids(self):
+        with self._lock:
+            return sorted(self._quarantined)
+
+    def engines(self):
+        """``{rid: engine}`` of healthy replicas — the telemetry hook
+        (`TelemetrySession.add_registry(engine.metrics,
+        tags={"replica": rid})` per entry)."""
+        with self._lock:
+            return {rid: rep.engine for rid, rep in self._replicas.items()}
+
+    def report(self):
+        """Fleet counters + per-replica `ServeEngine.report` snapshots.
+        The identity ``submitted == completed + failed + shed +
+        deadline_exceeded + requeued_then_completed`` holds whenever no
+        request is in flight (every accepted future has resolved)."""
+        with self._lock:
+            healthy = {
+                rid: rep.engine for rid, rep in self._replicas.items()
+            }
+            quarantined = sorted(self._quarantined)
+        return {
+            "submitted": self._m_submitted.value,
+            "completed": self._m_completed.value,
+            "failed": self._m_failed.value,
+            "shed": self._m_shed.value,
+            "deadline_exceeded": self._m_deadline.value,
+            "requeued": self._m_requeued.value,
+            "requeued_then_completed": self._m_requeued_completed.value,
+            "replicas_down": self._m_replicas_down.value,
+            "rejoins": self._m_rejoins.value,
+            "healthy": sorted(healthy),
+            "quarantined": quarantined,
+            "last_route": self._router.last_decision,
+            "per_replica": {
+                rid: eng.report() for rid, eng in healthy.items()
+            },
+        }
+
+    def close(self, timeout=None):
+        """Drain every replica; EVERY accepted future resolves before
+        this returns (engine drains resolve dispatched work; anything
+        still unresolved after — e.g. stranded on the requeue path —
+        fails with a typed ``RequestShed(reason="drain")``).
+        Idempotent."""
+        with self._close_lock:
+            already = self._closed
+            self._closed = True
+        if already:
+            return
+        self._requeue_q.put(_SENTINEL)
+        self._requeue_thread.join(timeout=timeout)
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            if rep.watchdog is not None:
+                rep.watchdog.stop(join_timeout=0)
+        for rep in reps:
+            rep.engine.shutdown(timeout=timeout)
+        with self._pending_lock:
+            leftovers = list(self._pending)
+            self._pending.clear()
+        for record in leftovers:
+            self._settle_exc(record, RequestShed(
+                "fleet closed before placement", reason="drain",
+            ))
+
+    def drain(self, timeout=None):
+        """Alias for `close` — the name `drain_on_preemption` calls (the
+        SIGTERM watcher works unchanged over a fleet)."""
+        self.close(timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False

@@ -23,6 +23,11 @@ together:
     the bounded queue is full. Subclasses ``queue.Full`` (callers that
     handled backpressure before this PR keep working) but adds the
     ``retry_after_s`` hint.
+  - :class:`ReplicaDown` — the fleet replica holding the request died
+    (killed / hung past the fleet watchdog). ``dispatched=False`` means
+    the request never reached the device and the fleet requeues it onto
+    a survivor; ``dispatched=True`` means the in-flight batch is lost
+    and the caller sees this typed failure (PR 11, `ncnet_tpu.serve.fleet`).
   - :class:`StageFailure` — the request was in flight on a pipeline stage
     that crashed or hung; ONLY in-flight requests fail this way, the
     stage restarts, and the warm compile cache survives
@@ -95,6 +100,25 @@ class DeadlineExceeded(RequestShed):
             message, reason="deadline", deadline_s=deadline_s
         )
         self.stage = stage
+
+
+class ReplicaDown(ServeResilienceError):
+    """The replica holding this request died (killed, crashed, or declared
+    hung by the fleet watchdog) before the request completed.
+
+    ``replica`` names the dead replica; ``dispatched`` distinguishes the
+    two fates the fleet contract assigns: ``False`` means the request was
+    still queued (never on the device) — the fleet REQUEUES it onto a
+    surviving replica, so callers normally never see this value —
+    ``True`` means the batch was already dispatched to the device when
+    the replica died, so the result is unrecoverable and the future
+    fails with THIS exception (typed, never silently dropped).
+    """
+
+    def __init__(self, message, *, replica=None, dispatched=False):
+        super().__init__(message)
+        self.replica = replica
+        self.dispatched = dispatched
 
 
 class AdmissionRejected(ServeResilienceError, queue.Full):

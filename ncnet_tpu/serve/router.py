@@ -1,0 +1,159 @@
+"""Fleet request routing: bucket-affinity placement + fleet-wide
+admission control.
+
+The single-engine admission story (PR 10) sheds a request when ITS
+engine's latency estimate says the deadline will be missed. A fleet must
+not shed that eagerly: replica A being backlogged is no reason to drop a
+request replica B could serve in time. `FleetRouter.route` therefore
+ranks replicas by estimated time-to-completion and sheds ONLY when no
+replica can meet the budget — the fleet-wide generalization of the same
+SLO contract.
+
+Two signals drive placement, in priority order:
+
+* **ETA** — per-replica `LatencyEstimator` EWMA (each replica feeds its
+  own: replicas can sit on heterogeneous devices or carry different
+  backlogs, so one fleet-wide EWMA would mis-estimate both) scaled by
+  queued work: ``max_wait + est * (1 + queued / max_batch) * margin``.
+  A replica whose estimator has no samples yet is BLIND — it is assumed
+  fast (effective ETA ``max_wait``, the floor any batch pays) and is
+  never shed against: admission control admits blind until measured,
+  exactly as the single-engine contract.
+* **Bucket affinity** — among replicas whose ETA is within
+  ``affinity_slack`` of the best, prefer one that already holds a
+  half-filled micro-batch for this request's bucket key
+  (`ServeEngine.pending_bucket_keys`): one more same-key request
+  completes a batch there instead of opening a fresh group elsewhere,
+  which raises occupancy fleet-wide without sacrificing latency (the
+  slack bound).
+
+Ties break round-robin so an idle fleet spreads load instead of
+hammering replica 0.
+
+Import-light by contract (stdlib only): `ServeFleet` imports this on
+every submit.
+"""
+
+import threading
+
+from ncnet_tpu.resilience import faultinject
+from ncnet_tpu.serve.resilience import RequestShed
+
+
+class ReplicaView:
+    """One replica's routing-relevant surface, decoupled from the engine
+    class so the router is testable with plain closures (and so a future
+    HTTP front door can route over remote replicas it only knows through
+    stats). The fleet builds one per healthy replica on every route."""
+
+    __slots__ = (
+        "replica", "estimator", "queued_fn", "keys_fn", "max_wait",
+        "max_batch",
+    )
+
+    def __init__(self, replica, *, estimator, queued_fn, keys_fn,
+                 max_wait, max_batch):
+        self.replica = replica
+        self.estimator = estimator
+        self.queued_fn = queued_fn
+        self.keys_fn = keys_fn
+        self.max_wait = max_wait
+        self.max_batch = max_batch
+
+
+class FleetRouter:
+    """Pick the replica for one request; shed only when NONE can serve
+    it in budget.
+
+    ``margin`` scales every ETA (pessimism knob, mirroring the engine's
+    ``deadline_margin``); ``affinity_slack`` bounds how much latency the
+    bucket-affinity preference may trade for occupancy (affinity only
+    wins among replicas with ``eta <= best * affinity_slack``).
+    """
+
+    def __init__(self, margin=1.0, affinity_slack=1.5):
+        if margin <= 0:
+            raise ValueError(f"margin must be > 0, got {margin}")
+        if affinity_slack < 1.0:
+            raise ValueError(
+                f"affinity_slack must be >= 1, got {affinity_slack}"
+            )
+        self.margin = margin
+        self.affinity_slack = affinity_slack
+        self._lock = threading.Lock()
+        self._rr = 0
+        # last routing decision, for the fleet report / debugging:
+        # {"replica", "eta_s", "affinity"}
+        self.last_decision = None
+
+    def eta(self, view, key=None):
+        """Estimated time-to-completion on ``view``: None while its
+        estimator is blind (no batch measured yet), else batch wait +
+        EWMA scaled by how many batches are already queued ahead."""
+        est = view.estimator.estimate(key)
+        if est is None:
+            return None
+        backlog = view.queued_fn() / max(view.max_batch, 1)
+        return view.max_wait + est * (1.0 + backlog) * self.margin
+
+    def route(self, views, *, key=None, deadline_s=None):
+        """Return the chosen `ReplicaView`.
+
+        Raises typed `RequestShed`: ``reason="unavailable"`` when
+        ``views`` is empty (every replica dead/quarantined),
+        ``reason="admission"`` when a deadline is set, every replica is
+        measured, and even the BEST ETA misses it — the fleet-wide shed.
+        """
+        faultinject.fire("serve.router.route")
+        views = list(views)
+        if not views:
+            raise RequestShed(
+                "no live replica (all dead or quarantined)",
+                reason="unavailable",
+            )
+        etas = [self.eta(v, key) for v in views]
+        known = [e for e in etas if e is not None]
+        if deadline_s is not None and len(known) == len(etas):
+            best = min(known)
+            if best > deadline_s:
+                raise RequestShed(
+                    f"no replica can meet deadline: best ETA {best:.4f}s "
+                    f"> budget {deadline_s:.4f}s",
+                    reason="admission",
+                    estimated_s=best,
+                    deadline_s=deadline_s,
+                    retry_after_s=best,
+                )
+        # blind replicas compete at the optimistic floor (max_wait): they
+        # must attract traffic or their estimator never gets a sample
+        eff = [
+            v.max_wait if e is None else e for v, e in zip(views, etas)
+        ]
+        best = min(eff)
+        slack = best * self.affinity_slack
+        candidates = [
+            (v, e) for v, e in zip(views, eff) if e <= slack
+        ]
+        chosen, chosen_eta, affinity = None, None, False
+        if key is not None:
+            with_key = [
+                (v, e) for v, e in candidates if key in v.keys_fn()
+            ]
+            if with_key:
+                chosen, chosen_eta = min(with_key, key=lambda ve: ve[1])
+                affinity = True
+        if chosen is None:
+            # min-ETA with round-robin tiebreak: an idle fleet (all ETAs
+            # equal) spreads instead of always picking index 0
+            with self._lock:
+                start = self._rr
+                self._rr += 1
+            n = len(candidates)
+            order = [candidates[(start + i) % n] for i in range(n)]
+            chosen, chosen_eta = min(order, key=lambda ve: ve[1])
+        self.last_decision = {
+            "replica": chosen.replica,
+            "eta_s": chosen_eta,
+            "affinity": affinity,
+        }
+        return chosen

@@ -71,13 +71,27 @@ class TelemetrySession:
             "process_index": self.process_index,
         })
         trace.enable(sink=self.writer.write)
+        self._extra = []  # [(registry, tags)] — see add_registry
         self._stopped = False
+
+    def add_registry(self, registry, tags=None):
+        """Snapshot an ADDITIONAL registry at every `flush_metrics`,
+        stamping its metric events with ``tags`` (e.g. the serving fleet
+        registers each replica engine's private registry with
+        ``{"replica": R}`` — private registries keep per-replica totals
+        apart, and the tags let `scripts/telemetry_report.py` key them
+        ``name{replica=R}`` in one fleet view)."""
+        self._extra.append((registry, dict(tags or {})))
 
     def flush_metrics(self):
         """Append one metric record per registered metric (also runs at
         `stop`; call mid-run for coarse time series)."""
         for event in metric_events(self.registry):
             self.writer.write(event)
+        for registry, tags in self._extra:
+            for event in metric_events(registry):
+                event.update(tags)
+                self.writer.write(event)
         self.writer.flush()
 
     def stop(self):

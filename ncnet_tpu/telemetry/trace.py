@@ -71,7 +71,7 @@ class _Span:
         path = ">".join(stack)
         if stack:
             stack.pop()
-        tracer._emit({
+        event = {
             "type": "span",
             "name": self.name,
             "path": path,
@@ -79,7 +79,11 @@ class _Span:
             "dur_s": t1 - self._t0,
             "thread": threading.get_ident(),
             "ok": exc_type is None,
-        })
+        }
+        tags = getattr(tracer._local, "tags", None)
+        if tags:
+            event.update(tags)
+        tracer._emit(event)
         return False
 
 
@@ -97,6 +101,23 @@ class Tracer:
         if not self._enabled:
             return _NULL_SPAN
         return _Span(self, name)
+
+    def set_thread_tag(self, key, value):
+        """Attach ``key: value`` to every span THIS thread emits from now
+        on (e.g. the serving fleet tags each replica's worker threads
+        ``replica=R`` so one merged report can tell them apart). Tags
+        ride on the event dict next to the standard fields; reserved
+        field names are rejected. Costs nothing while tracing is
+        disabled and one ``getattr`` per span while enabled."""
+        if key in ("type", "name", "path", "ts", "dur_s", "thread", "ok"):
+            raise ValueError(f"{key!r} is a reserved span field")
+        tags = getattr(self._local, "tags", None)
+        if tags is None:
+            tags = self._local.tags = {}
+        tags[key] = value
+
+    def clear_thread_tags(self):
+        self._local.tags = None
 
     def is_enabled(self):
         return self._enabled
@@ -148,3 +169,5 @@ is_enabled = _TRACER.is_enabled
 enable = _TRACER.enable
 disable = _TRACER.disable
 drain = _TRACER.drain
+set_thread_tag = _TRACER.set_thread_tag
+clear_thread_tags = _TRACER.clear_thread_tags

@@ -43,12 +43,32 @@ SLOs & resilience (ncnet_tpu.serve.resilience):
                            deadline; every accepted future resolves
                            (result or typed shed) before exit
 
+Fleet & mesh (ncnet_tpu.serve.fleet / PR 11):
+  --fleet / --replicas N   one device-pinned warmed engine per chip
+                           behind the bucket-affinity best-ETA router;
+                           fleet-wide admission sheds only when NO
+                           replica can meet the budget, a dead replica's
+                           queued work requeues onto survivors. On a
+                           CPU-only machine --replicas N provisions an
+                           N-virtual-device proxy mesh automatically
+                           (XLA_FLAGS, set before jax imports).
+  --shard-batch N          single-engine mode: batches padded to >= N
+                           rows run a shard_map variant of the bucket
+                           program spanning the device mesh (bitwise
+                           the single-device program per shard);
+                           mutually exclusive with --fleet — a pinned
+                           replica owns one chip, the sharded program
+                           owns the mesh.
+
 Fault drills: the engine fires the ``serve.request``,
 ``serve.worker.crash``, ``serve.dispatch.hang``, and
-``serve.readout.delay`` points, so e.g.
+``serve.readout.delay`` points — and the fleet adds
+``serve.replica.kill`` + ``serve.router.route`` — so e.g.
 ``NCNET_FAULTS="serve.worker.crash=crash@3"`` proves from the command
 line that a crashed prep worker fails ONLY its in-flight request
-(typed StageFailure), restarts, and recompiles_after_warmup stays 0.
+(typed StageFailure), restarts, and recompiles_after_warmup stays 0,
+and ``NCNET_FAULTS="serve.replica.kill=crash@40"`` runs the replica
+chaos drill under real traffic.
 
 Example:
   python scripts/serve.py --checkpoint ck.msgpack --pairs req.csv \
@@ -113,6 +133,18 @@ def parse_args(argv=None):
     p.add_argument("--sequential", action="store_true",
                    help="run the per-pair sequential baseline instead of "
                         "the batched engine")
+    p.add_argument("--fleet", action="store_true",
+                   help="serve through a ServeFleet: one device-pinned "
+                        "engine per chip behind the best-ETA router")
+    p.add_argument("--replicas", type=int, default=0,
+                   help="fleet size (implies --fleet; 0 with --fleet "
+                        "means one replica per visible device). On CPU "
+                        "this provisions an N-virtual-device proxy mesh")
+    p.add_argument("--shard-batch", type=int, default=0,
+                   help="single-engine: run batches padded to >= N rows "
+                        "through the shard_map bucket program spanning "
+                        "the device mesh (0 disables; exclusive with "
+                        "--fleet)")
     p.add_argument("--deadline-ms", type=float, default=0.0,
                    help="per-request SLO deadline in ms (0 disables); "
                         "drives admission-control shedding and "
@@ -190,6 +222,27 @@ def image_shape(path):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.replicas > 0:
+        args.fleet = True
+    if args.fleet and args.sequential:
+        raise SystemExit("--fleet and --sequential are exclusive")
+    if args.fleet and args.shard_batch > 0:
+        raise SystemExit(
+            "--fleet and --shard-batch are exclusive: a pinned replica "
+            "owns one chip, the sharded program owns the whole mesh"
+        )
+    if args.fleet and args.replicas > 1:
+        # CPU proxy mesh: must happen BEFORE anything imports jax (the
+        # backend reads XLA_FLAGS once at client creation). A no-op when
+        # jax is already in, the flag is already set, or on real TPUs
+        # (the flag only multiplies the HOST platform's device count).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if ("jax" not in sys.modules
+                and "xla_force_host_platform_device_count" not in flags):
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{args.replicas}"
+            ).strip()
 
     from ncnet_tpu import telemetry
 
@@ -225,8 +278,10 @@ def _run(args, telemetry):
         BucketSpec,
         DeadlineExceeded,
         HysteresisController,
+        ReplicaDown,
         RequestShed,
         ServeEngine,
+        ServeFleet,
         drain_on_preemption,
         make_serve_match_step,
         pair_bucket,
@@ -313,7 +368,8 @@ def _run(args, telemetry):
         )
 
     report = {
-        "mode": "sequential" if args.sequential else "serve",
+        "mode": ("sequential" if args.sequential
+                 else "fleet" if args.fleet else "serve"),
         "n_requests": len(requests),
         "concurrency": args.concurrency,
         "max_batch": args.max_batch,
@@ -363,23 +419,54 @@ def _run(args, telemetry):
             None if args.admission_timeout_ms < 0
             else args.admission_timeout_ms / 1e3
         )
-        with PreemptionGuard() as guard, ServeEngine(
-            apply_fn,
-            params,
+        hang = args.hang_timeout if args.hang_timeout > 0 else None
+        shard_mesh = None
+        if args.shard_batch > 0:
+            from ncnet_tpu.parallel.mesh import make_mesh
+
+            shard_mesh = make_mesh()
+        common = dict(
             max_batch=args.max_batch,
             max_wait=args.max_wait_ms / 1e3,
             queue_limit=args.queue_limit,
             host_workers=args.host_workers,
             prep_fn=prep,
             prep_retries=args.prep_retries,
-            registry=(telemetry.default_registry() if args.telemetry
-                      else None),
             degraded_apply_fn=degraded_apply_fn,
-            degrade_controller=controller,
-            hang_timeout=(
-                args.hang_timeout if args.hang_timeout > 0 else None
-            ),
-        ) as engine:
+        )
+        if args.fleet:
+            # per-replica engines keep PRIVATE registries (and, with
+            # --degrade, private default-threshold controllers — one
+            # shared mutable controller would race across dispatch
+            # threads); the session snapshots each with a {replica=R}
+            # tag, the fleet's own counters land in the default registry
+            server = ServeFleet(
+                apply_fn, params,
+                replicas=(args.replicas if args.replicas > 0 else None),
+                replica_hang_timeout=hang,
+                registry=(telemetry.default_registry() if args.telemetry
+                          else None),
+                **common,
+            )
+            report["replicas"] = len(server.replica_ids())
+            if args.telemetry:
+                session = telemetry.active()
+                for rid, eng in server.engines().items():
+                    session.add_registry(
+                        eng.metrics, tags={"replica": rid}
+                    )
+        else:
+            server = ServeEngine(
+                apply_fn, params,
+                registry=(telemetry.default_registry() if args.telemetry
+                          else None),
+                degrade_controller=controller,
+                hang_timeout=hang,
+                shard_mesh=shard_mesh,
+                shard_min_batch=args.shard_batch,
+                **common,
+            )
+        with PreemptionGuard() as guard, server as engine:
             # SIGTERM -> stop admission (clients poll guard.requested),
             # drain under the deadline: every accepted future resolves
             drain_on_preemption(
@@ -419,11 +506,19 @@ def _run(args, telemetry):
                         return
                     while True:
                         try:
-                            slots[i] = engine.submit(
-                                requests[i],
-                                timeout=adm_timeout,
-                                deadline_s=deadline_s,
-                            )
+                            if args.fleet:
+                                # fleet routing owns placement; a full
+                                # replica queue blocks inside dispatch
+                                # (natural backpressure)
+                                slots[i] = engine.submit(
+                                    requests[i], deadline_s=deadline_s
+                                )
+                            else:
+                                slots[i] = engine.submit(
+                                    requests[i],
+                                    timeout=adm_timeout,
+                                    deadline_s=deadline_s,
+                                )
                             break
                         except AdmissionRejected as exc:
                             # typed backpressure: honor the engine's
@@ -449,6 +544,7 @@ def _run(args, telemetry):
             # this EVERY accepted future below is resolved
             engine.drain(timeout=args.drain_timeout)
             ok = failed = shed = deadline_exceeded = unsubmitted = 0
+            replica_down = 0
             for fut in slots:
                 if fut is None:
                     unsubmitted += 1  # preemption stopped admission
@@ -460,11 +556,18 @@ def _run(args, telemetry):
                     deadline_exceeded += 1
                 except RequestShed:
                     shed += 1
+                except ReplicaDown:
+                    replica_down += 1  # dispatched batch died with its replica
                 except Exception:  # nclint: disable=swallowed-exception -- tallied: the per-type breakdown lives in the engine's typed counters
                     failed += 1
             wall = time.perf_counter() - t0
             stats = engine.report()
-        stats.pop("latencies_s")
+        if args.fleet:
+            for rep_stats in stats["per_replica"].values():
+                rep_stats.pop("latencies_s", None)
+            report["replica_down_results"] = replica_down
+        else:
+            stats.pop("latencies_s")
         report.update(stats)
         report.update(
             wall_s=wall,

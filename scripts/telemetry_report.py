@@ -73,17 +73,30 @@ def aggregate_spans(events):
     nesting separator; ``/`` belongs to span NAMES like
     "step/loss_sync") — so parentage is pure string structure;
     aggregation is across threads and repeats.
+
+    Spans carrying a ``replica`` tag (a serving fleet tags each
+    replica's worker threads — `telemetry.trace.set_thread_tag`) key as
+    ``path{replica=R}``: the fleet view stays one merged table while
+    per-replica rows remain distinguishable, the same convention
+    `final_metrics` uses for ``{proc=P}``.
     """
     durs = {}
     for e in events:
         if e.get("type") != "span":
             continue
-        durs.setdefault(e["path"], []).append(float(e["dur_s"]))
+        path = e["path"]
+        if "replica" in e:
+            path = f"{path}{{replica={e['replica']}}}"
+        durs.setdefault(path, []).append(float(e["dur_s"]))
     child_total = {}
     for path, samples in durs.items():
-        parent = path.rsplit(">", 1)[0] if ">" in path else None
-        if parent is not None:
-            child_total[parent] = child_total.get(parent, 0.0) + sum(samples)
+        # the {replica=R} suffix rides along to the parent key: parent
+        # and child spans come from the same (tagged) worker thread
+        base, _, tag = path.partition("{")
+        if ">" not in base:
+            continue
+        parent = base.rsplit(">", 1)[0] + (f"{{{tag}" if tag else "")
+        child_total[parent] = child_total.get(parent, 0.0) + sum(samples)
     out = {}
     for path, samples in sorted(durs.items()):
         total = sum(samples)
@@ -101,14 +114,20 @@ def aggregate_spans(events):
 def final_metrics(events):
     """Last metric record per name (the stop()-time snapshot wins).
     Events carrying a ``proc`` tag (multi-log runs — see `load_events`)
-    keep one final value PER process, keyed ``name{proc=P}``, so two
-    hosts' counters never last-wins-clobber each other."""
+    and/or a ``replica`` tag (a serving fleet publishes each replica
+    engine's private registry via `TelemetrySession.add_registry`) keep
+    one final value per tag combination, keyed ``name{proc=P}`` /
+    ``name{replica=R}`` / ``name{proc=P,replica=R}``, so neither two
+    hosts nor two replicas last-wins-clobber each other."""
     out = {}
     for e in events:
         if e.get("type") == "metric":
             name = e["name"]
-            if "proc" in e:
-                name = f"{name}{{proc={e['proc']}}}"
+            tags = [
+                f"{k}={e[k]}" for k in ("proc", "replica") if k in e
+            ]
+            if tags:
+                name = f"{name}{{{','.join(tags)}}}"
             out[name] = e
     return out
 

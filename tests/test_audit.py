@@ -333,9 +333,9 @@ def test_real_programs_zero_unsuppressed_findings():
     names = {r["program"] for r in result.reports}
     assert {
         "train/dense", "train/cached", "train/sparse",
-        "serve/bucket", "eval/match",
+        "serve/bucket", "serve/sharded", "eval/match",
     } <= names
-    assert len(names) >= 5
+    assert len(names) >= 6
 
 
 def test_real_train_programs_flop_walk_matches_accounting():

@@ -1,0 +1,499 @@
+"""Serving fleet (PR 11): router placement + fleet-wide admission
+(shed only when NO replica meets the budget), device-pinned replicas
+that never cross-dispatch, the replica chaos drill (kill mid-load:
+every accepted future resolves exactly once, queued work requeues onto
+survivors, zero post-warmup recompiles), quarantine/rejoin with
+re-warmup, the batch-axis shard_map program's bitwise parity contract,
+fleet throughput scaling on sleep-dominated load, and the merged
+{replica=R} telemetry view."""
+
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.parallel.mesh import make_batch_sharded_apply, make_mesh
+from ncnet_tpu.resilience import faultinject
+from ncnet_tpu.resilience.faultinject import InjectedFault
+from ncnet_tpu.serve import (
+    DeadlineExceeded,
+    FleetRouter,
+    LatencyEstimator,
+    ReplicaDown,
+    ReplicaView,
+    RequestShed,
+    ServeEngine,
+    ServeFleet,
+    ServeResilienceError,
+)
+from ncnet_tpu.telemetry import trace
+from ncnet_tpu.telemetry.session import TelemetrySession
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))  # for scripts.telemetry_report
+
+from scripts.telemetry_report import (  # noqa: E402
+    aggregate_spans,
+    final_metrics,
+    load_events,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.clear()
+    yield
+    faultinject.clear()
+
+
+TOY_PARAMS = {"w": jnp.asarray(3.0, jnp.float32)}
+KEY = ("k", 2)
+SPEC = {"x": ((2,), np.float32)}
+
+
+def _toy_apply(p, batch):
+    return {"y": batch["x"] * p["w"]}
+
+
+def _toy_fleet(**kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait", 0.002)
+    return ServeFleet(_toy_apply, TOY_PARAMS, **kw)
+
+
+def _toy_payload(n, fill):
+    return {"x": np.full((n,), fill, np.float32)}
+
+
+def _identity(stats):
+    """The fleet's exactly-once ledger: every accepted request lands in
+    exactly one outcome counter."""
+    assert stats["submitted"] == (
+        stats["completed"] + stats["failed"] + stats["shed"]
+        + stats["deadline_exceeded"] + stats["requeued_then_completed"]
+    )
+
+
+def _view(rid, est_s=None, queued=0, keys=(), max_wait=0.005,
+          max_batch=8):
+    est = LatencyEstimator()
+    if est_s is not None:
+        est.observe(KEY, est_s)
+    return ReplicaView(
+        rid, estimator=est, queued_fn=lambda: queued,
+        keys_fn=lambda: tuple(keys), max_wait=max_wait,
+        max_batch=max_batch,
+    )
+
+
+# ----------------------------------------------------------------------
+# router: placement + fleet-wide admission policy
+
+
+def test_replica_down_taxonomy():
+    exc = ReplicaDown("m", replica=3, dispatched=True)
+    assert isinstance(exc, ServeResilienceError)
+    assert not isinstance(exc, RequestShed)  # a failure, not a choice
+    assert exc.replica == 3 and exc.dispatched
+    assert not ReplicaDown("m").dispatched
+
+
+def test_router_unavailable_when_no_replicas():
+    with pytest.raises(RequestShed) as ei:
+        FleetRouter().route([])
+    assert ei.value.reason == "unavailable"
+
+
+def test_router_sheds_only_when_no_replica_meets_deadline():
+    router = FleetRouter()
+    slow = _view(0, est_s=2.0)
+    fast = _view(1, est_s=1.0)
+    # even the best ETA misses the budget -> fleet-wide admission shed
+    with pytest.raises(RequestShed) as ei:
+        router.route([slow, fast], key=KEY, deadline_s=0.5)
+    exc = ei.value
+    assert exc.reason == "admission"
+    assert exc.estimated_s == pytest.approx(1.005, rel=0.01)
+    assert exc.retry_after_s == exc.estimated_s
+    # one replica CAN meet it: route there, never shed
+    assert router.route([slow, fast], key=KEY, deadline_s=1.5).replica == 1
+    # a BLIND replica admits: estimator-less capacity must attract
+    # traffic (or it never gets a sample), same contract as the engine
+    blind = _view(2)
+    chosen = router.route([slow, fast, blind], key=KEY, deadline_s=0.5)
+    assert chosen.replica == 2
+
+
+def test_router_prefers_min_eta_and_backlog_scales_it():
+    router = FleetRouter()
+    # same EWMA, but replica 0 has a full max_batch of queued work: its
+    # ETA doubles and replica 1 wins
+    busy = _view(0, est_s=1.0, queued=8)
+    idle = _view(1, est_s=1.0)
+    assert router.route([busy, idle], key=KEY).replica == 1
+    assert router.last_decision["replica"] == 1
+    assert not router.last_decision["affinity"]
+
+
+def test_router_bucket_affinity_within_slack_only():
+    router = FleetRouter(affinity_slack=1.5)
+    plain = _view(0, est_s=1.0)
+    half_batch = _view(1, est_s=1.0, keys=(KEY,))
+    chosen = router.route([plain, half_batch], key=KEY)
+    assert chosen.replica == 1  # completes the half-filled batch
+    assert router.last_decision["affinity"]
+    # affinity may NOT trade more than the slack bound of latency
+    laggard = _view(2, est_s=5.0, keys=(KEY,))
+    assert router.route([plain, laggard], key=KEY).replica == 0
+
+
+def test_router_round_robin_spreads_idle_fleet():
+    router = FleetRouter()
+    views = [_view(i) for i in range(4)]  # all blind, all equal
+    chosen = {router.route(views).replica for _ in range(8)}
+    assert chosen == {0, 1, 2, 3}
+
+
+# ----------------------------------------------------------------------
+# device pinning: co-resident engines never cross-dispatch
+
+
+def test_fleet_engines_pinned_one_per_device():
+    devices = jax.devices()
+    assert len(devices) >= 4, "conftest provisions the 8-device proxy mesh"
+    fleet = _toy_fleet(replicas=4)
+    try:
+        engines = fleet.engines()
+        for rid, eng in engines.items():
+            for leaf in jax.tree_util.tree_leaves(eng._params):
+                assert leaf.devices() == {devices[rid]}, (
+                    f"replica {rid} params not pinned to its device"
+                )
+        fleet.warmup([(KEY, SPEC)])
+        futs = [
+            fleet.submit(key=KEY, payload=_toy_payload(2, float(i)))
+            for i in range(16)
+        ]
+        for i, f in enumerate(futs):
+            np.testing.assert_array_equal(
+                np.asarray(f.result(timeout=10)["y"]),
+                np.full((2,), 3.0 * i, np.float32),
+            )
+    finally:
+        fleet.close()
+
+
+# ----------------------------------------------------------------------
+# accounting identity
+
+
+def test_fleet_accounting_identity_across_outcomes():
+    fleet = _toy_fleet(replicas=2)
+    try:
+        fleet.warmup([(KEY, SPEC)])
+        futs = [
+            fleet.submit(key=KEY, payload=_toy_payload(2, 1.0))
+            for _ in range(10)
+        ]
+        # an already-expired budget resolves typed at the route stage
+        dead = fleet.submit(
+            key=KEY, payload=_toy_payload(2, 1.0), deadline_s=-1.0
+        )
+        for f in futs:
+            f.result(timeout=10)
+        with pytest.raises(DeadlineExceeded) as ei:
+            dead.result(timeout=10)
+        assert ei.value.stage == "route"
+        # an injected routing crash resolves the future, never raises
+        # into the caller
+        faultinject.inject("serve.router.route", "crash", at=1)
+        broken = fleet.submit(key=KEY, payload=_toy_payload(2, 1.0))
+        assert isinstance(broken.exception(timeout=10), InjectedFault)
+    finally:
+        fleet.close()
+    stats = fleet.report()
+    assert stats["submitted"] == 12
+    assert stats["completed"] == 10
+    assert stats["deadline_exceeded"] == 1
+    assert stats["failed"] == 1
+    _identity(stats)
+
+
+def test_fleet_close_resolves_everything_and_refuses_new_work():
+    fleet = _toy_fleet(replicas=2)
+    fleet.warmup([(KEY, SPEC)])
+    futs = [
+        fleet.submit(key=KEY, payload=_toy_payload(2, 1.0))
+        for _ in range(8)
+    ]
+    fleet.close()
+    assert all(f.done() for f in futs)
+    _identity(fleet.report())
+    with pytest.raises(RuntimeError):
+        fleet.submit(key=KEY, payload=_toy_payload(2, 1.0))
+    fleet.close()  # idempotent
+
+
+# ----------------------------------------------------------------------
+# the chaos drill: kill a replica mid-load
+
+
+def test_fleet_chaos_drill_replica_kill_mid_load():
+    fleet = _toy_fleet(replicas=4)
+    try:
+        fleet.warmup([(KEY, SPEC)])
+        # the 10th dispatch kills its routed-to replica under real load
+        faultinject.inject("serve.replica.kill", "crash", at=10)
+        futs = [
+            fleet.submit(key=KEY, payload=_toy_payload(2, float(i)))
+            for i in range(60)
+        ]
+        outcomes = {"ok": 0, "down": 0}
+        for i, f in enumerate(futs):
+            try:
+                np.testing.assert_array_equal(
+                    np.asarray(f.result(timeout=10)["y"]),
+                    np.full((2,), 3.0 * i, np.float32),
+                )
+                outcomes["ok"] += 1
+            except ReplicaDown as exc:
+                # only a batch already ON the dead device may fail;
+                # queued work must requeue instead
+                assert exc.dispatched
+                outcomes["down"] += 1
+        # every accepted future resolved exactly once
+        assert all(f.done() for f in futs)
+        assert outcomes["ok"] + outcomes["down"] == 60
+        stats = fleet.report()
+        _identity(stats)
+        assert stats["replicas_down"] == 1
+        assert len(stats["quarantined"]) == 1
+        assert len(stats["healthy"]) == 3
+        # survivors keep their warm caches: zero recompiles fleet-wide
+        for rid, rep in stats["per_replica"].items():
+            assert rep["recompiles_after_warmup"] == 0, f"replica {rid}"
+    finally:
+        fleet.close()
+
+
+def test_fleet_quarantine_rejoin_zero_recompiles():
+    fleet = _toy_fleet(replicas=3)
+    try:
+        fleet.warmup([(KEY, SPEC)])
+        faultinject.inject("serve.replica.kill", "crash", at=5)
+        futs = [
+            fleet.submit(key=KEY, payload=_toy_payload(2, 1.0))
+            for i in range(20)
+        ]
+        for f in futs:
+            try:
+                f.result(timeout=10)
+            except ReplicaDown:
+                pass
+        faultinject.clear()
+        dead = fleet.quarantined_ids()
+        assert len(dead) == 1
+        # rejoin: fresh engine, same device, re-warmed from the fleet's
+        # recorded specs BEFORE it takes traffic
+        n = fleet.rejoin(dead[0])
+        assert n > 0
+        with pytest.raises(ValueError):
+            fleet.rejoin(dead[0])  # healthy again: a double rejoin is a bug
+        assert fleet.quarantined_ids() == []
+        assert fleet.replica_ids() == [0, 1, 2]
+        futs = [
+            fleet.submit(key=KEY, payload=_toy_payload(2, 2.0))
+            for i in range(20)
+        ]
+        for f in futs:
+            f.result(timeout=10)
+        stats = fleet.report()
+        _identity(stats)
+        assert stats["rejoins"] == 1
+        # the rejoined replica included: zero post-warmup recompiles
+        # survive a kill + rejoin cycle
+        for rid, rep in stats["per_replica"].items():
+            assert rep["recompiles_after_warmup"] == 0, f"replica {rid}"
+    finally:
+        fleet.close()
+
+
+# ----------------------------------------------------------------------
+# fleet scaling: 8 replicas vs 1 on the same synthetic load
+
+
+def _sleep_apply(p, batch):
+    # sleep-dominated device stage: a host callback that sleeps stands
+    # in for a TPU chip's compute — the CPU proxy has ONE core, so only
+    # a GIL-releasing sleep makes 8 virtual devices truly concurrent
+    def host_sleep(x):
+        time.sleep(0.08)
+        return x
+
+    y = jax.pure_callback(
+        host_sleep,
+        jax.ShapeDtypeStruct(batch["x"].shape, batch["x"].dtype),
+        batch["x"],
+    )
+    return {"y": y * p["w"]}
+
+
+def _timed_fleet_run(replicas, n_requests):
+    fleet = ServeFleet(
+        _sleep_apply, TOY_PARAMS, replicas=replicas,
+        max_batch=1, max_wait=0.001,
+    )
+    try:
+        fleet.warmup([(KEY, SPEC)])
+        t0 = time.perf_counter()
+        futs = [
+            fleet.submit(key=KEY, payload=_toy_payload(2, 1.0))
+            for _ in range(n_requests)
+        ]
+        for f in futs:
+            f.result(timeout=60)
+        wall = time.perf_counter() - t0
+        _identity(fleet.report())
+    finally:
+        fleet.close()
+    return wall
+
+
+def test_fleet_scaling_8x_replicas_beats_5x():
+    assert len(jax.devices()) >= 8
+    n = 32
+    wall_1 = _timed_fleet_run(1, n)   # serial: >= 32 * 80ms
+    wall_8 = _timed_fleet_run(8, n)   # ~4 sleeps per replica
+    speedup = wall_1 / wall_8
+    assert speedup >= 5.0, (
+        f"8 replicas gave only {speedup:.1f}x over 1 "
+        f"({wall_1:.2f}s -> {wall_8:.2f}s)"
+    )
+
+
+# ----------------------------------------------------------------------
+# batch-axis shard_map: the parity contract
+
+
+def _dot_apply(p, batch):
+    # a reduction makes parity meaningful: codegen differences between
+    # programs would show up in the contraction's float associativity
+    return {"y": jnp.dot(batch["x"], p["w"])}
+
+
+def test_shard_map_bitwise_parity_per_shard():
+    mesh = make_mesh()
+    n = mesh.size
+    rng = np.random.default_rng(0)
+    params = {"w": jnp.asarray(rng.standard_normal(4).astype(np.float32))}
+    x = rng.standard_normal((n, 4)).astype(np.float32)
+    sharded = jax.jit(make_batch_sharded_apply(_dot_apply, mesh))
+    single = jax.jit(_dot_apply)
+    out = np.asarray(sharded(params, {"x": x})["y"])
+    # the contract: bitwise the single-device program applied per shard
+    # and concatenated (across different batch SIZES only few-ulp
+    # associativity is promised — PR 6 pins that separately)
+    per_shard = np.concatenate([
+        np.asarray(single(params, {"x": x[i:i + 1]})["y"])
+        for i in range(n)
+    ])
+    assert np.array_equal(out, per_shard)
+
+
+def test_engine_sharded_dispatch_bitwise_and_warm():
+    mesh = make_mesh()
+    n = mesh.size
+    rng = np.random.default_rng(1)
+    params = {"w": jnp.asarray(rng.standard_normal(4).astype(np.float32))}
+    key = ("dot", 4)
+    spec = {"x": ((4,), np.float32)}
+    engine = ServeEngine(
+        _dot_apply, params, max_batch=n, max_wait=0.5,
+        shard_mesh=mesh, shard_min_batch=n,
+    )
+    try:
+        engine.warmup([(key, spec)])
+        xs = [rng.standard_normal(4).astype(np.float32) for _ in range(n)]
+        futs = [
+            engine.submit(key=key, payload={"x": x.copy()}) for x in xs
+        ]
+        results = [np.asarray(f.result(timeout=30)["y"]) for f in futs]
+        single = jax.jit(_dot_apply)
+        for x, got in zip(xs, results):
+            want = np.asarray(single(params, {"x": x[None]})["y"])[0]
+            assert np.array_equal(got, want)
+        stats = engine.report()
+        assert stats["sharded_batches"] >= 1
+        assert stats["recompiles_after_warmup"] == 0
+    finally:
+        engine.close()
+
+
+def test_engine_small_batches_stay_single_device():
+    mesh = make_mesh()
+    n = mesh.size
+    engine = ServeEngine(
+        _toy_apply, TOY_PARAMS, max_batch=n, max_wait=0.001,
+        shard_mesh=mesh, shard_min_batch=n,
+    )
+    try:
+        engine.warmup([(KEY, SPEC)])
+        # a lone request pads to 1: not divisible by the mesh, so the
+        # single-device program serves it — no cross-device batch of one
+        fut = engine.submit(key=KEY, payload=_toy_payload(2, 5.0))
+        np.testing.assert_array_equal(
+            np.asarray(fut.result(timeout=10)["y"]),
+            np.full((2,), 15.0, np.float32),
+        )
+        stats = engine.report()
+        assert stats["sharded_batches"] == 0
+        assert stats["recompiles_after_warmup"] == 0
+    finally:
+        engine.close()
+
+
+# ----------------------------------------------------------------------
+# telemetry: one fleet view with {replica=R} tags
+
+
+def test_fleet_telemetry_merged_replica_view(tmp_path):
+    sess = TelemetrySession(str(tmp_path), label="fleet")
+    fleet = None
+    try:
+        fleet = _toy_fleet(replicas=2)
+        for rid, eng in fleet.engines().items():
+            sess.add_registry(eng.metrics, tags={"replica": rid})
+        fleet.warmup([(KEY, SPEC)])
+        futs = [
+            fleet.submit(key=KEY, payload=_toy_payload(2, 1.0))
+            for _ in range(12)
+        ]
+        for f in futs:
+            f.result(timeout=10)
+        fleet.close()
+    finally:
+        if fleet is not None:
+            fleet.close()
+        sess.stop()
+        trace.disable()
+        trace.drain()
+    events = load_events(str(tmp_path))
+    # metrics: one final value PER replica, keyed with the tag — private
+    # registries kept the totals apart, the tags keep them attributable
+    metrics = final_metrics(events)
+    per_replica = [
+        metrics[f"serve_requests_submitted_total{{replica={r}}}"]["value"]
+        for r in (0, 1)
+    ]
+    assert sum(per_replica) == 12
+    assert all(v > 0 for v in per_replica)  # the router spread the load
+    # spans: worker threads carried their replica tag into the log
+    spans = aggregate_spans(events)
+    tagged = [p for p in spans if "{replica=" in p]
+    assert tagged, f"no replica-tagged spans in {sorted(spans)[:8]}"

@@ -8,6 +8,7 @@ import json
 import math
 import os
 import sys
+import time
 import tracemalloc
 from pathlib import Path
 
@@ -180,24 +181,37 @@ def test_disabled_span_is_shared_noop_singleton():
 
 def test_disabled_span_allocates_nothing():
     """The hot loops keep their spans unconditionally; the disabled path
-    must not allocate (tracemalloc sees zero new blocks from trace.py)."""
+    must not allocate (tracemalloc sees zero new blocks from trace.py).
+
+    Measured up to 3 times: in a long full-suite run a straggler
+    background thread from an earlier test (serving drills leave
+    fault-delayed threads that wake a minute later) can allocate a
+    couple of trace.py blocks (thread tags, a late span emit) inside
+    the tracemalloc window. That noise is transient and tiny; a REAL
+    disabled-path regression allocates on every one of the 100 spans
+    in every measurement, so requiring ONE clean measurement keeps the
+    zero-allocation contract exact."""
     assert not trace.is_enabled()
     span = trace.span  # the bound method, as instrumentation sites use it
     with span("warm/up"):
         pass
-    tracemalloc.start()
-    try:
-        for _ in range(100):
-            with span("step/device_compute"):
-                pass
-        snap = tracemalloc.take_snapshot()
-    finally:
-        tracemalloc.stop()
     trace_py = os.path.join("telemetry", "trace.py")
-    allocs = [
-        s for s in snap.statistics("filename")
-        if s.traceback[0].filename.endswith(trace_py)
-    ]
+    for _attempt in range(3):
+        tracemalloc.start()
+        try:
+            for _ in range(100):
+                with span("step/device_compute"):
+                    pass
+            snap = tracemalloc.take_snapshot()
+        finally:
+            tracemalloc.stop()
+        allocs = [
+            s for s in snap.statistics("filename")
+            if s.traceback[0].filename.endswith(trace_py)
+        ]
+        if allocs == []:
+            return
+        time.sleep(0.2)  # let the straggler finish, then re-measure
     assert allocs == [], f"disabled span allocated: {allocs}"
 
 
