@@ -124,7 +124,7 @@ class ServeFleet:
         self._closed = False
         self._close_lock = threading.Lock()
 
-        self._lock = threading.Lock()  # replica table + quarantine set
+        self._lock = threading.Lock()  # replica table + quarantine + warm specs
         self._replicas = {}  # rid -> _Replica (healthy, routable)
         self._quarantined = {}  # rid -> device (killed, awaiting rejoin)
         self._warm_specs = {}  # key -> per-sample spec (rejoin re-warms)
@@ -233,7 +233,9 @@ class ServeFleet:
         if device is None:
             raise KeyError(f"no quarantined replica {rid!r}")
         engine = self._start_replica(rid, device)
-        n = engine.warmup(list(self._warm_specs.items()))
+        with self._lock:
+            warm = list(self._warm_specs.items())
+        n = engine.warmup(warm)
         self._m_rejoins.inc()
         return n
 
@@ -242,8 +244,9 @@ class ServeFleet:
         `ServeEngine.warmup` contract, fleet-wide) and RECORD the specs:
         `rejoin` re-warms a replacement replica from this record."""
         specs = list(bucket_specs)
-        for key, pspec in specs:
-            self._warm_specs[key] = pspec
+        with self._lock:
+            for key, pspec in specs:
+                self._warm_specs[key] = pspec
         total = 0
         for rep in self._healthy():
             total += rep.engine.warmup(specs)
@@ -341,10 +344,18 @@ class ServeFleet:
                 deadline_s=self._remaining(record),
             )
         except RuntimeError as exc:
-            # includes AdmissionRejected; a closed engine means the kill
-            # raced our routing decision — re-route, don't fail
+            # includes AdmissionRejected; a closed engine means either a
+            # kill raced our routing decision (re-route onto a survivor)
+            # or the fleet is draining — close() shuts engines down but
+            # leaves them routable, so re-routing there would bounce
+            # between closed replicas forever: shed typed instead
             if engine.closed:
-                self._route_and_dispatch(record)
+                if self._closed:
+                    self._settle_exc(record, RequestShed(
+                        "fleet closed during placement", reason="drain",
+                    ))
+                else:
+                    self._route_and_dispatch(record)
             else:
                 self._settle_exc(record, exc)
             return

@@ -317,7 +317,11 @@ class Watchdog:
 
     def stop(self, join_timeout=None):
         self._stop.set()
-        if self._thread.is_alive():
+        # on_hang may stop its own watchdog (a fleet killing a hung
+        # replica); joining the current thread would raise and kill the
+        # hang-handler mid-flight — _stop alone already ends the loop.
+        if (self._thread.is_alive()
+                and self._thread is not threading.current_thread()):
             self._thread.join(join_timeout)
 
 

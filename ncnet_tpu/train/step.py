@@ -6,6 +6,7 @@ explicit sub-pytree, so gradients are only computed and optimizer state only
 kept for what actually trains.
 """
 
+import functools
 from typing import Any, NamedTuple
 
 import jax
@@ -104,7 +105,13 @@ def merge_trainable(params, trainable, cnn="resnet101"):
     return out
 
 
+@functools.lru_cache(maxsize=None)
 def make_optimizer(learning_rate=5e-4):
+    # memoized so repeated `train()` calls in one process (resume loops,
+    # preemption retries, tests) get the SAME transform object — which
+    # lets `make_train_step` reuse its jitted step instead of recompiling
+    # an identical program (optax transforms are stateless; state lives
+    # in opt_state)
     return optax.adam(learning_rate)
 
 
@@ -179,6 +186,36 @@ def make_train_step(
     check_sparse_config(config)
     if from_features:
         check_from_features_frozen(train_fe, fe_finetune_blocks)
+    # one jitted step per distinct configuration per process: a resumed
+    # or retried `train()` reuses the executable instead of recompiling
+    # an identical program (also makes resume-vs-uninterrupted bitwise
+    # equality hold by construction — same executable object). The
+    # sanitizer flag is part of the key because `sanitize_pytree` bakes
+    # its taps in at trace time. Unhashable args (a live mesh closure,
+    # say) just skip the cache.
+    try:
+        return _cached_train_step(
+            config, optimizer, train_fe, normalization, donate,
+            fe_finetune_blocks, from_features, sanitizer.is_enabled(),
+        )
+    except TypeError:
+        return _build_train_step(
+            config, optimizer, train_fe, normalization, donate,
+            fe_finetune_blocks, from_features,
+        )
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_train_step(config, optimizer, train_fe, normalization, donate,
+                       fe_finetune_blocks, from_features, _sanitize):
+    return _build_train_step(
+        config, optimizer, train_fe, normalization, donate,
+        fe_finetune_blocks, from_features,
+    )
+
+
+def _build_train_step(config, optimizer, train_fe, normalization, donate,
+                      fe_finetune_blocks, from_features):
     loss_impl = weak_loss_from_features if from_features else weak_loss
     cnn = config.feature_extraction_cnn
 
@@ -212,6 +249,18 @@ def make_eval_step(config, normalization="softmax", from_features=False):
     (``source_features``/``target_features`` batches) with zero backbone
     ops — same math, the trunk forward simply never runs."""
     check_sparse_config(config)
+    try:
+        return _cached_eval_step(config, normalization, from_features)
+    except TypeError:
+        return _build_eval_step(config, normalization, from_features)
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_eval_step(config, normalization, from_features):
+    return _build_eval_step(config, normalization, from_features)
+
+
+def _build_eval_step(config, normalization, from_features):
     loss_impl = weak_loss_from_features if from_features else weak_loss
 
     def eval_fn(params, batch):

@@ -372,6 +372,10 @@ def test_extract_features_cli_smoke(tmp_path):
         "--feature-cache", str(tmp_path / "cache"),
         "--synthetic", "--synthetic_n", "4", "--synthetic_val_n", "2",
         "--image_size", "32", "--batch_size", "2",
+        # the smoke drills CLI wiring + cache completeness, not the trunk:
+        # patch16 keeps both subprocess runs off the minute-scale resnet
+        # compile (same trunk choice as the serve/eval parity tests)
+        "--fe_arch", "patch16",
         "--compile-cache", str(tmp_path / "xla_cache"),
     ]
     r = subprocess.run(

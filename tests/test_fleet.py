@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from ncnet_tpu.parallel.mesh import make_batch_sharded_apply, make_mesh
 from ncnet_tpu.resilience import faultinject
 from ncnet_tpu.resilience.faultinject import InjectedFault
+from ncnet_tpu.serve.fleet import _Request
 from ncnet_tpu.serve import (
     DeadlineExceeded,
     FleetRouter,
@@ -322,6 +323,79 @@ def test_fleet_quarantine_rejoin_zero_recompiles():
             assert rep["recompiles_after_warmup"] == 0, f"replica {rid}"
     finally:
         fleet.close()
+
+
+def test_fleet_watchdog_kills_hung_replica_and_fleet_survives():
+    # the replica_hang_timeout supervision path: a device call that
+    # never returns must be declared dead BY THE WATCHDOG (not an
+    # injected fault), its in-flight future failed typed, and the fleet
+    # keep serving on survivors. Regression: kill_replica runs ON the
+    # watchdog thread; Watchdog.stop must not try to join itself, or
+    # the kill dies mid-flight and the poison future below hangs.
+    release = threading.Event()
+
+    def hang_apply(p, batch):
+        def maybe_hang(x):
+            if float(x.ravel()[0]) < 0:
+                release.wait(30.0)  # "wedged device" until test teardown
+            return x
+
+        y = jax.pure_callback(
+            maybe_hang,
+            jax.ShapeDtypeStruct(batch["x"].shape, batch["x"].dtype),
+            batch["x"],
+        )
+        return {"y": y * p["w"]}
+
+    fleet = ServeFleet(
+        hang_apply, TOY_PARAMS, replicas=3, max_batch=1, max_wait=0.001,
+        replica_hang_timeout=0.25,
+    )
+    try:
+        fleet.warmup([(KEY, SPEC)])
+        poison = fleet.submit(key=KEY, payload=_toy_payload(2, -1.0))
+        with pytest.raises(ReplicaDown) as ei:
+            poison.result(timeout=15)
+        assert ei.value.dispatched  # on-device when killed: lost, typed
+        # kill_replica quarantines BEFORE it fails futures, so the dead
+        # replica is already out of routing
+        assert len(fleet.quarantined_ids()) == 1
+        assert len(fleet.replica_ids()) == 2
+        futs = [
+            fleet.submit(key=KEY, payload=_toy_payload(2, float(i)))
+            for i in range(12)
+        ]
+        for i, f in enumerate(futs):
+            np.testing.assert_array_equal(
+                np.asarray(f.result(timeout=10)["y"]),
+                np.full((2,), 3.0 * i, np.float32),
+            )
+        stats = fleet.report()
+        _identity(stats)
+        assert stats["replicas_down"] == 1
+        for rid, rep in stats["per_replica"].items():
+            assert rep["recompiles_after_warmup"] == 0, f"replica {rid}"
+    finally:
+        release.set()
+        fleet.close()
+
+
+def test_fleet_dispatch_racing_close_sheds_typed():
+    # a record that reaches _dispatch_to after close() shut the engines
+    # down must shed typed (reason="drain"), not bounce between closed
+    # replicas until RecursionError: close() leaves engines in the
+    # replica table, so re-routing there can never succeed
+    fleet = _toy_fleet(replicas=2)
+    fleet.warmup([(KEY, SPEC)])
+    rid = fleet.replica_ids()[0]
+    fleet.close()
+    record = _Request(None, KEY, _toy_payload(2, 1.0), None)
+    with fleet._pending_lock:
+        fleet._pending.add(record)
+    fleet._dispatch_to(rid, record)
+    with pytest.raises(RequestShed) as ei:
+        record.future.result(timeout=5)
+    assert ei.value.reason == "drain"
 
 
 # ----------------------------------------------------------------------

@@ -187,6 +187,26 @@ def test_watchdog_fires_only_when_busy_and_stale():
                  on_hang=lambda: None)
 
 
+def test_watchdog_stop_from_its_own_on_hang():
+    # the fleet's hang handler stops the very watchdog that fired it
+    # (kill_replica runs ON the watchdog thread); stop() must not join
+    # the current thread — that raises and kills the handler mid-kill
+    box = {}
+    handled = threading.Event()
+
+    def on_hang():
+        box["dog"].stop(join_timeout=0)  # pre-fix: RuntimeError here
+        handled.set()
+
+    dog = Watchdog(0.05, beat_fn=lambda: 0.0, busy_fn=lambda: True,
+                   on_hang=on_hang)
+    box["dog"] = dog
+    dog.start()
+    assert handled.wait(5.0)  # the handler ran to completion
+    dog._thread.join(5.0)
+    assert not dog._thread.is_alive()  # _stop alone ended the loop
+
+
 # ----------------------------------------------------------------------
 # admission control + deadlines on the engine
 
