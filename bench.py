@@ -8,10 +8,15 @@ here ends with a device-to-host transfer of the loss (``float(loss)``),
 which does force execution, and the loss is asserted finite so a broken
 step can't report a throughput.
 
-Extras report achieved model FLOP utilization (MFU) against the v5e bf16
-peak so absurd numbers are self-evident: analytic FLOPs per step are
-derived from the config below (the 25^4 x 5^4 NC convolutions dominate:
-conv2 alone is ~125 GFLOP/pair/direction).
+Extras report achieved model FLOP utilization (MFU) against BOTH v5e
+peaks (`mfu_vs_bf16_peak`, `mfu_vs_f32_peak` — the MXU has no native
+f32 multiply, so the honest denominator depends on the compute dtype,
+reported as `compute_dtype`) so absurd numbers are self-evident:
+analytic FLOPs per step are derived from the config below (the
+25^4 x 5^4 NC convolutions dominate: conv2 alone is ~125
+GFLOP/pair/direction). Training compute is bf16 by default
+(`--no-bf16` for the f32 step; master params/loss/opt state are f32
+either way).
 
 ``--feature-cache [DIR]`` benchmarks the frozen-trunk feature-cache step
 (ncnet_tpu.features): the trunk runs ONCE outside the timed region (with
@@ -102,6 +107,8 @@ V100_EST_PAIRS_PER_SEC = 4.0
 # JSON tooling).
 from ncnet_tpu.ops.accounting import (  # noqa: E402
     V5E_BF16_PEAK_FLOPS,
+    compute_dtype,
+    peak_flops,
     train_step_flops,
 )
 
@@ -210,6 +217,14 @@ def main():
                    help="with --nc-topk: symmetric/mutual band selection "
                         "(union of per-A and per-B ranks, swap-closed up "
                         "to capacity) vs plain per-A top-K")
+    p.add_argument("--bf16", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="bf16 features/correlation/NC compute with f32 "
+                        "master params and f32 loss/optimizer state (the "
+                        "default train path); --no-bf16 runs the full-f32 "
+                        "step for the bf16-vs-f32 ratio in PERF.md. The "
+                        "JSON records compute_dtype and reports MFU "
+                        "against BOTH dtype peaks")
     p.add_argument("--image_size", type=int, default=400,
                    help="square input size; 400 is the flagship config — "
                         "smaller sizes are CPU-proxy runs (the JSON is "
@@ -274,7 +289,7 @@ def _run(args):
     config = ImMatchNetConfig(
         ncons_kernel_sizes=preset["kernels"],
         ncons_channels=preset["channels"],
-        half_precision=True,  # bf16 correlation/NC path (TPU-native)
+        half_precision=args.bf16,  # bf16 correlation/NC path (TPU-native)
         conv4d_impl=impl,
         nc_remat=args.nc_remat,
         loss_chunk=loss_chunk,
@@ -373,7 +388,13 @@ def _run(args):
         grid=grid, image=size, from_features=from_features,
         nc_topk=args.nc_topk,
     )
-    mfu = (step_flops * n_steps / dt) / V5E_BF16_PEAK_FLOPS
+    achieved_flops = step_flops * n_steps / dt
+    mfu = achieved_flops / V5E_BF16_PEAK_FLOPS
+    # the dual-MFU pair: the same achieved rate against both dtype peaks,
+    # so a --no-bf16 run is judged against the ceiling f32 compute can
+    # actually reach and a bf16 run is not flattered by the lower bar
+    mfu_f32 = achieved_flops / peak_flops("float32")
+    dtype = compute_dtype(config)
     from ncnet_tpu.telemetry import default_registry
 
     reg = default_registry()
@@ -384,6 +405,9 @@ def _run(args):
         dt / n_steps * 1e3
     )
     reg.gauge("bench_mfu", "bench analytic MFU vs v5e bf16 peak").set(mfu)
+    reg.gauge(
+        "bench_mfu_vs_f32_peak", "bench analytic MFU vs v5e f32 peak"
+    ).set(mfu_f32)
     sparse_extras = {}
     if args.nc_topk:
         # the dense-vs-band analytic pair: BENCH_r*.json trajectories stay
@@ -412,7 +436,10 @@ def _run(args):
                 ],
                 "step_ms": round(dt / n_steps * 1e3, 1),
                 "analytic_tflop_per_step": round(step_flops / 1e12, 2),
+                "compute_dtype": dtype,
                 "mfu_vs_v5e_bf16_peak": round(mfu, 4),
+                "mfu_vs_bf16_peak": round(mfu, 4),
+                "mfu_vs_f32_peak": round(mfu_f32, 4),
                 **sparse_extras,
                 **({"feature_cache": True} if from_features else {}),
                 **({"image_size": size} if size != 400 else {}),

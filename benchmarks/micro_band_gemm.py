@@ -1,0 +1,223 @@
+"""Microbenchmark: one sparse-band NC layer (gather + GEMM + bias + ReLU)
+across the three lowerings the dispatch can pick.
+
+  xla     the production composite (ops.band.band_conv_gemm via the
+          custom-VJP `_band_conv` + bias + relu) — gather and GEMM are
+          separate XLA ops with an HBM round-trip between them
+  pallas  the fused kernel (ncnet_tpu/kernels/band_gemm_pallas.py):
+          gather + MXU GEMM + bias + ReLU in one launch. Off-TPU this
+          runs in INTERPRET mode — a correctness-grade number (the
+          Python interpreter of the kernel, orders of magnitude slow),
+          recorded so the JSON schema is stable; the perf claim can
+          only be measured on a TPU backend
+  gemm4   the dense conv4d at the same geometry (conv4d_packed
+          impl='gemm4', the band path's bitwise oracle at K = hB*wB) —
+          the dense-equivalent work the band avoids; its analytic
+          FLOPs are the DENSE count, so the gap between its and the
+          band rows' useful-FLOP rates is the band's real win
+
+Two default geometries: the PF-Pascal flagship band layer (grid 25,
+5^4 kernels, K=40, 16->16 — the shape that carries ~89% of the sparse
+step's FLOPs) and the IVD band layer (3^4 kernels, K=20, 16->1-ish mid
+shape). JSON lines on stdout, one per (geometry, impl): ms/step via
+honest slope timing (benchmarks/timing.py), analytic GFLOPs, and the
+achieved useful rate.
+
+Usage:
+  JAX_PLATFORMS=cpu python benchmarks/micro_band_gemm.py           # both
+  python benchmarks/micro_band_gemm.py --geometry pfpascal --grad
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from timing import time_chain
+
+GEOMETRIES = {
+    # grid, kernel, K, cin, cout — band-layer shapes of the two flagship
+    # configs (middle layer: widest lanes, dominant FLOP share). TPU-
+    # sized: at grid 25 the XLA path's gathered block is
+    # [b, 25^2*K, k^4*cin] — GBs on a CPU host, fine in HBM.
+    "pfpascal": dict(grid=25, k=5, K=40, cin=16, cout=16),
+    "ivd": dict(grid=25, k=3, K=20, cin=16, cout=16),
+    # CPU-proxy shapes (the off-TPU default): same structure, small
+    # enough that the interpret-mode Pallas rows finish in seconds —
+    # these rows VALIDATE the harness and the relative XLA-vs-dense
+    # shape; absolute rates only mean something from a TPU run
+    "pfpascal-proxy": dict(grid=8, k=5, K=12, cin=16, cout=16),
+    "ivd-proxy": dict(grid=8, k=3, K=8, cin=16, cout=16),
+}
+
+
+def build_band(rng, b, grid, K, cin, k):
+    """A realistic random band: top-K of a random correlation, plus the
+    layer input entries and the conv pointer table."""
+    from ncnet_tpu.ops.band import band_neighbor_pointers, topk_band
+
+    scores = jnp.asarray(
+        rng.randn(b, grid, grid, grid, grid).astype(np.float32)
+    )
+    _, indices = topk_band(scores, K)
+    n = grid * grid * K
+    x = jnp.asarray(rng.randn(b, n, cin).astype(np.float32))
+    ptr = band_neighbor_pointers(indices, (grid, grid), (k, k, k, k))
+    return x, ptr.reshape(b, n, -1)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--geometry", choices=[*GEOMETRIES, "all"], default=None,
+                   help="default: the two flagship shapes on a TPU "
+                        "backend, their CPU-proxy shrinks elsewhere")
+    p.add_argument("--impls", default="xla,pallas,gemm4",
+                   help="comma-separated subset of xla,pallas,gemm4")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--grad", action="store_true",
+                   help="also time forward+backward (3x fwd FLOPs)")
+    args = p.parse_args()
+
+    from ncnet_tpu.kernels.band_gemm_pallas import band_conv_bias_relu_pallas
+    from ncnet_tpu.ops.conv4d import conv4d_packed
+    from ncnet_tpu.sparse.nc import _band_conv
+
+    dtype = jnp.dtype(args.dtype)
+    b = args.batch
+    interpret = jax.default_backend() != "tpu"
+    if args.geometry is None:
+        names = (
+            ["pfpascal", "ivd"] if not interpret
+            else ["pfpascal-proxy", "ivd-proxy"]
+        )
+    elif args.geometry == "all":
+        names = list(GEOMETRIES)
+    else:
+        names = [args.geometry]
+    impls = [s for s in args.impls.split(",") if s]
+
+    for name in names:
+        geo = GEOMETRIES[name]
+        grid, k, K, cin, cout = (
+            geo["grid"], geo["k"], geo["K"], geo["cin"], geo["cout"]
+        )
+        rng = np.random.RandomState(0)
+        x, ptr = build_band(rng, b, grid, K, cin, k)
+        x = x.astype(dtype)
+        w = jnp.asarray(
+            rng.randn(k, k, k, k, cin, cout) * (cin * k**4) ** -0.5, dtype
+        )
+        bias = jnp.asarray(rng.randn(cout) * 0.01, dtype)
+        xp_dense = jnp.asarray(
+            rng.randn(b, grid, grid, grid * grid * cin).astype(np.float32),
+            dtype,
+        )
+        band_flops = 2.0 * b * grid**2 * K * k**4 * cin * cout
+        dense_flops = 2.0 * b * grid**4 * k**4 * cin * cout
+
+        # weights/pointers ride as jit ARGUMENTS, not closure constants:
+        # captured constants get constant-folded per chain length (XLA
+        # warns and burns minutes at the 625-tap pointer tables)
+        def layer(impl):
+            if impl == "xla":
+                return (
+                    lambda xx, w_, b_, p_: jax.nn.relu(
+                        _band_conv(xx, w_, p_) + b_.astype(dtype)
+                    ),
+                    x, (w, bias, ptr), band_flops,
+                )
+            if impl == "pallas":
+                return (
+                    lambda xx, w_, b_, p_: band_conv_bias_relu_pallas(
+                        xx, w_, b_, p_, interpret=interpret
+                    ),
+                    x, (w, bias, ptr), band_flops,
+                )
+            if impl == "gemm4":
+                return (
+                    lambda xx, w_, b_: jax.nn.relu(
+                        conv4d_packed(xx, w_, (grid, grid), b_, impl="gemm4")
+                    ),
+                    xp_dense, (w, bias), dense_flops,
+                )
+            raise ValueError(impl)
+
+        for impl in impls:
+            fn, x0, fargs, flops = layer(impl)
+            # cout == cin at these geometries, so the layer output feeds
+            # the next repeat directly (accumulate against DCE)
+            def make_chain(n, fn=fn):
+                @jax.jit
+                def f(xx, *rest):
+                    acc = xx
+                    for _ in range(n):
+                        acc = acc + fn(acc, *rest)
+                    return acc
+
+                return f, (x0, *fargs)
+
+            row = {
+                "bench": "band_gemm",
+                "geometry": name,
+                "impl": impl,
+                "dtype": dtype.name,
+                "batch": b,
+                "grid": grid,
+                "k": k,
+                "K": K,
+                "analytic_gflop": round(flops / 1e9, 3),
+                **({"interpret": True}
+                   if impl == "pallas" and interpret else {}),
+            }
+            try:
+                dt = time_chain(make_chain)
+            except Exception as e:
+                row["error"] = f"{type(e).__name__}: {str(e)[:120]}"
+                print(json.dumps(row), flush=True)
+                continue
+            row["ms"] = round(dt * 1e3, 3)
+            row["gflops_per_s"] = round(flops / dt / 1e9, 2)
+            print(json.dumps(row), flush=True)
+
+            if not args.grad:
+                continue
+
+            def make_grad_chain(n, fn=fn):
+                def loss(xx, *rest):
+                    return jnp.sum(fn(xx, *rest).astype(jnp.float32))
+
+                gradf = jax.grad(loss)
+
+                @jax.jit
+                def f(xx, *rest):
+                    acc = xx
+                    for _ in range(n):
+                        acc = acc + gradf(acc, *rest).astype(dtype)
+                    return acc
+
+                return f, (x0, *fargs)
+
+            grow = dict(row, pass_="fwd+bwd")
+            grow.pop("ms", None)
+            grow.pop("gflops_per_s", None)
+            try:
+                dt = time_chain(make_grad_chain)
+            except Exception as e:
+                grow["error"] = f"{type(e).__name__}: {str(e)[:120]}"
+                print(json.dumps(grow), flush=True)
+                continue
+            grow["ms"] = round(dt * 1e3, 3)
+            grow["gflops_per_s"] = round(3 * flops / dt / 1e9, 2)
+            print(json.dumps(grow), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,414 @@
+"""HLO-level audit pass: inspect the COMPILED modules of the registered
+entry programs.
+
+The jaxpr pass (`jaxpr_audit`) sees the program XLA is asked to compile;
+this pass sees what XLA actually made of it — the answer to "where does
+the remaining utilization go" that no jaxpr walk can give:
+
+  fusion-fragmentation   the entry computation launches many kernels per
+                         contraction: the NC stack lowering as a long
+                         chain of small fusions with HBM round-trips
+                         between them is exactly the MFU plateau's
+                         signature
+  layout-churn           transpose/copy ops surviving in the ENTRY
+                         computation (not fused into a consumer): each
+                         one is a full HBM round-trip that moves bytes
+                         without computing anything
+  memory-highwater       a linear-scan buffer-liveness estimate over the
+                         traced jaxpr exceeds the program's budget:
+                         catches residual-stacking / gather-inflation
+                         regressions long before an OOM on hardware
+
+Statistics come from two sources, both recorded in the report row:
+
+  * the optimized HLO text (``jit(f).lower(args).compile().as_text()``):
+    an opcode census of the ENTRY computation — ops inside fusion bodies
+    are NOT counted as launches (a fused transpose is a register
+    relayout, a top-level one is an HBM round-trip);
+  * a buffer-liveness walk over the traced jaxpr: allocate at the
+    defining equation, free after the last use, carry sub-jaxpr peaks as
+    transients. An ESTIMATE — XLA's buffer assignment aliases donated
+    inputs and reuses dead buffers, so the walk upper-bounds the
+    un-aliased live set rather than reproducing XLA's number (the
+    compiled module's own ``temp_size_in_bytes`` rides along in the
+    report for cross-reference).
+
+Budgets are regression tripwires, not absolute judgments: set from the
+measured seed values with ~3x headroom so the gate stays at zero
+findings until a change actually regresses the lowering.
+"""
+
+import dataclasses
+import re
+import time
+from collections import Counter
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from ncnet_tpu.analysis.findings import SEVERITY_ORDER, Finding
+from ncnet_tpu.analysis.jaxpr_audit import (
+    BuiltProgram,
+    TracedProgram,
+    _aval_bytes,
+    _iter_sub_jaxprs,
+    _leaf_bytes,
+    iter_eqns,
+)
+
+# --- budgets (module-level so the golden tests can monkeypatch them) ---------
+
+#: entry-computation kernel launches per jaxpr contraction before
+#: fusion-fragmentation fires. Seed measurements (CPU, audit geometry):
+#: serve/eval 6.8-7.4, train/dense 10.2, train/dense-bf16 11.4,
+#: train/sparse 11.5, train/sparse-bf16 11.7 — budget is ~3x the worst.
+FRAGMENTATION_OPS_PER_CONTRACTION = 36.0
+
+#: minimum entry-computation size for the fragmentation ratio to be
+#: meaningful (tiny programs divide by almost nothing)
+FRAGMENTATION_MIN_OPS = 24
+
+#: un-fused transpose+copy ops tolerated in the entry computation before
+#: layout-churn fires: max(MIN_OPS, FRACTION * entry ops). Seed: dense
+#: programs 0-3 churn ops, the sparse band's scatter/gather lowering
+#: 23-25 of ~395 entry ops (6.4% — the fraction budget is ~2.3x that).
+LAYOUT_CHURN_MIN_OPS = 24
+LAYOUT_CHURN_FRACTION = 0.15
+
+#: liveness-estimate budget: max(ABS floor, RATIO * program input bytes).
+#: Seed peak/input ratios: dense 1.02-1.08, train/dense-bf16 1.45,
+#: train/sparse 1.70 worst — RATIO is ~3.5x that; the floor only shields
+#: KB-scale toy programs from ratio noise.
+MEM_HIGHWATER_ABS_FLOOR = 4 * 1024 * 1024
+MEM_HIGHWATER_INPUT_RATIO = 6.0
+
+#: opcodes that are bookkeeping, not kernel launches
+_FREE_OPCODES = frozenset(
+    {"parameter", "constant", "tuple", "get-tuple-element", "bitcast"}
+)
+_CONTRACTION_PRIMS = ("dot_general", "conv_general_dilated")
+
+
+# --- compiled-module model ---------------------------------------------------
+
+
+@dataclasses.dataclass
+class HloProgram:
+    """One entry program compiled to an optimized HLO module."""
+
+    name: str
+    built: BuiltProgram
+    entry_ops: Dict[str, int]  # opcode -> count, ENTRY computation only
+    contractions: int  # dot/conv eqns in the traced jaxpr (scan-multiplied)
+    peak_bytes_est: int  # jaxpr buffer-liveness highwater
+    bytes_in: int
+    hlo_temp_bytes: Optional[int]  # XLA's own temp allocation, if exposed
+    compile_seconds: float = 0.0
+
+    @property
+    def entry_total(self) -> int:
+        return sum(self.entry_ops.values())
+
+    @property
+    def entry_launches(self) -> int:
+        return sum(
+            n for op, n in self.entry_ops.items() if op not in _FREE_OPCODES
+        )
+
+    @property
+    def fusions(self) -> int:
+        return self.entry_ops.get("fusion", 0)
+
+    @property
+    def churn_ops(self) -> int:
+        return self.entry_ops.get("transpose", 0) + self.entry_ops.get(
+            "copy", 0
+        )
+
+
+_OPCODE_RE = re.compile(r"=\s+(?:\([^)]*\)|\S+)\s+([\w-]+)\(")
+
+
+def parse_entry_opcodes(hlo_text: str) -> Dict[str, int]:
+    """Opcode census of the ENTRY computation of an HLO module dump.
+
+    Nested (fusion-body) computations are excluded: an op inside a
+    fusion is part of one launch, not a launch of its own.
+    """
+    m = re.search(r"^ENTRY ", hlo_text, re.M)
+    if not m:
+        raise ValueError("no ENTRY computation in HLO text")
+    entry = hlo_text[m.start():]
+    end = entry.find("\n}")
+    if end != -1:
+        entry = entry[: end + 2]
+    return dict(Counter(_OPCODE_RE.findall(entry)))
+
+
+def _sub_jaxpr_input_bytes(jaxpr) -> int:
+    return sum(
+        _aval_bytes(v.aval)
+        for v in list(jaxpr.invars) + list(jaxpr.constvars)
+        if hasattr(getattr(v, "aval", None), "dtype")
+    )
+
+
+def jaxpr_memory_highwater(jaxpr) -> int:
+    """Linear-scan buffer-liveness estimate of peak live bytes.
+
+    Allocate every equation's outputs at its program point, free each
+    value after its last use (program outputs live to the end), and
+    carry each sub-jaxpr's own peak (minus its inputs, which alias the
+    caller's live buffers) as a transient at the calling equation. No
+    donation/aliasing model — this upper-bounds XLA's assignment; use it
+    for RELATIVE regression tracking, not absolute HBM sizing.
+    """
+    from jax.core import Literal
+
+    def var_ok(v):
+        return not isinstance(v, Literal) and hasattr(
+            getattr(v, "aval", None), "dtype"
+        )
+
+    last_use: Dict[Any, int] = {}
+    for i, e in enumerate(jaxpr.eqns):
+        for v in e.invars:
+            if var_ok(v):
+                last_use[v] = i
+    n = len(jaxpr.eqns)
+    for v in jaxpr.outvars:
+        if var_ok(v):
+            last_use[v] = n
+
+    alloc: Dict[Any, int] = {}
+    live = 0
+    for v in list(jaxpr.invars) + list(jaxpr.constvars):
+        if var_ok(v) and v not in alloc:
+            alloc[v] = _aval_bytes(v.aval)
+            live += alloc[v]
+    peak = live
+    for i, e in enumerate(jaxpr.eqns):
+        sub_extra = 0
+        for val in e.params.values():
+            for sub in _iter_sub_jaxprs(val):
+                sub_extra = max(
+                    sub_extra,
+                    jaxpr_memory_highwater(sub)
+                    - _sub_jaxpr_input_bytes(sub),
+                )
+        out_bytes = 0
+        for v in e.outvars:
+            if var_ok(v) and v not in alloc:
+                b = _aval_bytes(v.aval)
+                alloc[v] = b
+                out_bytes += b
+        live += out_bytes
+        peak = max(peak, live + max(sub_extra, 0))
+        for v in list(e.invars) + list(e.outvars):
+            if var_ok(v) and v in alloc and last_use.get(v, i) <= i:
+                live -= alloc.pop(v)
+    return peak
+
+
+def compile_program(name: str, built: BuiltProgram,
+                    traced: TracedProgram) -> HloProgram:
+    """Compile ``built.fn`` and collect the HLO/memory statistics."""
+    t0 = time.perf_counter()
+    compiled = built.fn.lower(*built.args).compile()
+    dt = time.perf_counter() - t0
+    entry_ops = parse_entry_opcodes(compiled.as_text())
+    temp = None
+    try:
+        stats = compiled.memory_analysis()
+        if stats is not None:
+            temp = int(stats.temp_size_in_bytes)
+    except Exception:  # nclint: disable=swallowed-exception -- capability probe: some backends have no memory_analysis(); hlo_temp_bytes stays None and the liveness estimate still gates
+        pass
+    contractions = sum(
+        m
+        for e, m in iter_eqns(traced.jaxpr)
+        if e.primitive.name in _CONTRACTION_PRIMS
+    )
+    bytes_in = sum(
+        _leaf_bytes(leaf)
+        for leaves in traced.arg_leaves
+        for leaf in leaves
+    )
+    return HloProgram(
+        name=name,
+        built=built,
+        entry_ops=entry_ops,
+        contractions=int(contractions),
+        peak_bytes_est=jaxpr_memory_highwater(traced.jaxpr),
+        bytes_in=bytes_in,
+        hlo_temp_bytes=temp,
+        compile_seconds=dt,
+    )
+
+
+# --- HLO rule registry -------------------------------------------------------
+
+HloRuleFn = Callable[[HloProgram], Iterator[Tuple[str, Optional[dict]]]]
+
+HLO_RULES: Dict[str, "HloRule"] = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class HloRule:
+    rule_id: str
+    severity: str
+    doc: str
+    fn: HloRuleFn
+
+
+def hlo_rule(rule_id: str, severity: str = "warning", doc: str = ""):
+    if severity not in SEVERITY_ORDER:
+        raise ValueError(f"unknown severity {severity!r}")
+
+    def wrap(fn: HloRuleFn) -> HloRuleFn:
+        if rule_id in HLO_RULES:
+            raise ValueError(f"duplicate hlo rule id {rule_id!r}")
+        HLO_RULES[rule_id] = HloRule(
+            rule_id, severity, doc or (fn.__doc__ or ""), fn
+        )
+        return fn
+
+    return wrap
+
+
+@hlo_rule(
+    "fusion-fragmentation",
+    "warning",
+    doc="The entry computation launches many kernels per contraction: "
+        "the program lowered as a long chain of small fusions with HBM "
+        "round-trips between them — the compiled-side signature of the "
+        "MFU plateau. Budget: launches/contraction <= "
+        "FRAGMENTATION_OPS_PER_CONTRACTION (regression tripwire, set "
+        "from seed measurements with headroom).",
+)
+def fusion_fragmentation(hp: HloProgram) -> Iterator[Tuple[str, Optional[dict]]]:
+    launches = hp.entry_launches
+    if launches < FRAGMENTATION_MIN_OPS:
+        return
+    per = launches / max(hp.contractions, 1)
+    if per > FRAGMENTATION_OPS_PER_CONTRACTION:
+        yield (
+            f"{launches} entry-computation launches for "
+            f"{hp.contractions} contraction(s) ({per:.1f}/contraction, "
+            f"budget {FRAGMENTATION_OPS_PER_CONTRACTION:.0f}): the "
+            "lowering fragmented — look for new layout breaks between "
+            "the NC layers",
+            {
+                "launches": launches,
+                "contractions": hp.contractions,
+                "per_contraction": round(per, 2),
+                "budget": FRAGMENTATION_OPS_PER_CONTRACTION,
+            },
+        )
+
+
+@hlo_rule(
+    "layout-churn",
+    "warning",
+    doc="transpose/copy ops surviving at the top of the entry "
+        "computation: each is a kernel launch that moves bytes through "
+        "HBM without computing anything (fused transposes are free and "
+        "not counted). Budget: max(LAYOUT_CHURN_MIN_OPS, "
+        "LAYOUT_CHURN_FRACTION of entry ops).",
+)
+def layout_churn(hp: HloProgram) -> Iterator[Tuple[str, Optional[dict]]]:
+    budget = max(
+        LAYOUT_CHURN_MIN_OPS, int(LAYOUT_CHURN_FRACTION * hp.entry_total)
+    )
+    churn = hp.churn_ops
+    if churn > budget:
+        yield (
+            f"{churn} un-fused transpose/copy op(s) in the entry "
+            f"computation (budget {budget}): layout churn between "
+            "stages is back — check dimension orders at the producer/"
+            "consumer boundary",
+            {
+                "transpose": hp.entry_ops.get("transpose", 0),
+                "copy": hp.entry_ops.get("copy", 0),
+                "entry_ops": hp.entry_total,
+                "budget": budget,
+            },
+        )
+
+
+@hlo_rule(
+    "memory-highwater",
+    "warning",
+    doc="The buffer-liveness estimate of peak live bytes exceeds the "
+        "program's budget (max(MEM_HIGHWATER_ABS_FLOOR, "
+        "MEM_HIGHWATER_INPUT_RATIO * input bytes)): residual stacking "
+        "or gather inflation crept in — catch it here, not as an OOM "
+        "on hardware.",
+)
+def memory_highwater(hp: HloProgram) -> Iterator[Tuple[str, Optional[dict]]]:
+    budget = max(
+        MEM_HIGHWATER_ABS_FLOOR,
+        int(MEM_HIGHWATER_INPUT_RATIO * hp.bytes_in),
+    )
+    if hp.peak_bytes_est > budget:
+        yield (
+            f"estimated memory highwater {hp.peak_bytes_est:,} bytes "
+            f"exceeds the budget {budget:,} (inputs {hp.bytes_in:,}): "
+            "the live set blew up — check for stacked residuals or an "
+            "unbounded gather",
+            {
+                "peak_bytes_est": hp.peak_bytes_est,
+                "bytes_in": hp.bytes_in,
+                "budget": budget,
+                "hlo_temp_bytes": hp.hlo_temp_bytes,
+            },
+        )
+
+
+def run_hlo_rules(
+    hp: HloProgram,
+    waivers: Optional[Dict[str, str]] = None,
+    rules: Optional[List[str]] = None,
+) -> Tuple[List[Finding], List[Finding]]:
+    """Run (selected) HLO rules over one compiled program.
+
+    Same waiver discipline as the jaxpr pass; bad-waiver errors are
+    emitted THERE (the specs share one waiver dict), so this only
+    splits waived findings out.
+    """
+    waivers = dict(waivers or {})
+    path = f"hlo:{hp.name}"
+    findings: List[Finding] = []
+    waived: List[Finding] = []
+    selected = (
+        list(HLO_RULES.values()) if rules is None
+        else [HLO_RULES[r] for r in rules if r in HLO_RULES]
+    )
+    for r in selected:
+        for message, detail in r.fn(hp):
+            f = Finding(path, 1, 0, r.rule_id, r.severity, message, detail)
+            if r.rule_id in waivers and (waivers[r.rule_id] or "").strip():
+                waived.append(f)
+            else:
+                findings.append(f)
+    findings.sort(key=lambda f: (SEVERITY_ORDER[f.severity], f.rule),
+                  reverse=True)
+    return findings, waived
+
+
+def hlo_report(hp: HloProgram) -> Dict[str, Any]:
+    """The HLO columns merged into the program's report row."""
+    return {
+        "hlo_entry_ops": hp.entry_total,
+        "hlo_fusions": hp.fusions,
+        "hlo_churn": hp.churn_ops,
+        "hlo_contractions": hp.contractions,
+        "mem_highwater_est": hp.peak_bytes_est,
+        "hlo_temp_bytes": hp.hlo_temp_bytes,
+        "compile_seconds": round(hp.compile_seconds, 3),
+    }
+
+
+def hlo_rules_meta() -> Dict[str, dict]:
+    return {
+        r.rule_id: {"severity": r.severity, "doc": r.doc}
+        for r in HLO_RULES.values()
+    }

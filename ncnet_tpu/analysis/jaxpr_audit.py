@@ -589,14 +589,14 @@ def _build_train(nc_topk=0, from_features=False, half_precision=False):
     state = create_train_state(params, optimizer)
     step = make_train_step(config, optimizer, from_features=from_features)
     batch = _feature_batch() if from_features else _image_batch()
-    expected = None
-    if not half_precision:
-        # the closed form models the f32 path; bf16 runs the same
-        # contractions at a different dtype, but the walk-vs-form check
-        # is owned by the f32 programs to keep one source of truth
-        expected = train_step_flops_for_batch(
-            config, batch, from_features=from_features, trunk_trainable=False
-        )
+    # the closed form counts contraction shapes, which are dtype-
+    # independent: the bf16 programs run the SAME dots/convs as their
+    # f32 twins, so walk==form is armed on both (a bf16-only extra
+    # contraction — a stray promotion cast re-contracting, say — shows
+    # up here as drift)
+    expected = train_step_flops_for_batch(
+        config, batch, from_features=from_features, trunk_trainable=False
+    )
     return BuiltProgram(
         fn=step,
         args=(state, batch),
@@ -703,6 +703,14 @@ PROGRAMS: Dict[str, ProgramSpec] = {
             lambda: _build_train(half_precision=True),
         ),
         ProgramSpec(
+            "train/sparse-bf16",
+            "sparse-band training step on the declared-bf16 compute path "
+            "(cached features cast at the loss boundary)",
+            lambda: _build_train(
+                nc_topk=4, from_features=True, half_precision=True
+            ),
+        ),
+        ProgramSpec(
             "serve/bucket",
             "serving engine bucket program (the warmup-compiled apply)",
             _build_serve,
@@ -736,12 +744,21 @@ class AuditResult:
 def audit(
     programs: Optional[Iterable[str]] = None,
     rules: Optional[Iterable[str]] = None,
+    hlo: bool = False,
 ) -> AuditResult:
     """Build, trace, and rule-check the registered entry programs.
 
     A program that fails to build or trace is itself an error finding
     (``audit-trace-failure``) — the gate must not silently skip a broken
     entry point.
+
+    With ``hlo=True`` each successfully traced program is ALSO compiled
+    and the HLO-level pass (`ncnet_tpu.analysis.hlo_audit`: fusion
+    fragmentation, layout churn, memory highwater) runs over the
+    optimized module; its statistics merge into the same report row and
+    a compile failure is an ``audit-compile-failure`` error finding.
+    ``rules`` selects across BOTH registries (a selection naming only
+    jaxpr rules simply runs no HLO rules).
     """
     names = list(programs) if programs is not None else sorted(PROGRAMS)
     unknown = [n for n in names if n not in PROGRAMS]
@@ -751,7 +768,8 @@ def audit(
     for name in names:
         spec = PROGRAMS[name]
         try:
-            traced = trace_program(name, spec.build())
+            built = spec.build()
+            traced = trace_program(name, built)
         except Exception as e:  # build/trace failure IS a finding
             result.errors.append(
                 Finding(
@@ -760,20 +778,54 @@ def audit(
                 )
             )
             continue
-        findings, waived = run_jaxpr_rules(traced, spec.waivers, rules)
+        jaxpr_rule_sel = rules
+        if rules is not None:
+            jaxpr_rule_sel = [r for r in rules if r in JAXPR_RULES]
+        findings, waived = run_jaxpr_rules(traced, spec.waivers,
+                                           jaxpr_rule_sel)
         result.findings.extend(findings)
         result.waived.extend(waived)
-        result.reports.append(program_report(traced))
+        report = program_report(traced)
+        if hlo:
+            from ncnet_tpu.analysis import hlo_audit
+
+            try:
+                hp = hlo_audit.compile_program(name, built, traced)
+            except Exception as e:
+                result.errors.append(
+                    Finding(
+                        f"hlo:{name}", 1, 0, "audit-compile-failure",
+                        "error",
+                        "program traced but failed to compile for the "
+                        f"HLO pass: {type(e).__name__}: {e}",
+                    )
+                )
+            else:
+                hlo_rule_sel = None
+                if rules is not None:
+                    hlo_rule_sel = [
+                        r for r in rules if r in hlo_audit.HLO_RULES
+                    ]
+                hfindings, hwaived = hlo_audit.run_hlo_rules(
+                    hp, spec.waivers, hlo_rule_sel
+                )
+                result.findings.extend(hfindings)
+                result.waived.extend(hwaived)
+                report.update(hlo_audit.hlo_report(hp))
+        result.reports.append(report)
     return result
 
 
 def rules_meta() -> Dict[str, dict]:
     """{rule_id: {severity, doc}} for SARIF emission / --list-rules,
-    including the engine-level pseudo-rules."""
+    including the HLO pass's rules and the engine-level pseudo-rules."""
+    from ncnet_tpu.analysis.hlo_audit import hlo_rules_meta
+
     meta = {
         r.rule_id: {"severity": r.severity, "doc": r.doc}
         for r in JAXPR_RULES.values()
     }
+    meta.update(hlo_rules_meta())
     meta["bad-waiver"] = {
         "severity": "error",
         "doc": "a ProgramSpec waiver without a reason: every waived rule "
@@ -782,6 +834,11 @@ def rules_meta() -> Dict[str, dict]:
     meta["audit-trace-failure"] = {
         "severity": "error",
         "doc": "a registered entry program failed to build or trace",
+    }
+    meta["audit-compile-failure"] = {
+        "severity": "error",
+        "doc": "a registered entry program traced but failed to compile "
+               "for the HLO-level pass",
     }
     return meta
 
@@ -805,14 +862,22 @@ def format_flops(n: Optional[float]) -> str:
 
 
 def format_report_table(reports: List[Dict[str, Any]]) -> str:
-    """The telemetry_report-style human table over per-program stats."""
+    """The telemetry_report-style human table over per-program stats.
+
+    When the HLO pass ran (``audit(hlo=True)``), its per-program columns
+    — entry-computation fusion count, un-fused transpose/copy churn, and
+    the buffer-liveness memory-highwater estimate — extend the table.
+    """
+    with_hlo = any("hlo_fusions" in r for r in reports)
     headers = [
         "program", "eqns", "flops(walk)", "flops(form)", "in",
         "donated", "out", "const", "trace s",
     ]
+    if with_hlo:
+        headers += ["fusions", "churn", "mem(hw)", "compile s"]
     rows = []
     for r in reports:
-        rows.append([
+        row = [
             r["program"],
             str(r["eqns"]),
             format_flops(r["flops_walked"]),
@@ -822,7 +887,18 @@ def format_report_table(reports: List[Dict[str, Any]]) -> str:
             format_bytes(r["bytes_out"]),
             format_bytes(r["bytes_const"]),
             f"{r['trace_seconds']:.2f}",
-        ])
+        ]
+        if with_hlo:
+            if "hlo_fusions" in r:
+                row += [
+                    str(r["hlo_fusions"]),
+                    str(r["hlo_churn"]),
+                    format_bytes(r["mem_highwater_est"]),
+                    f"{r['compile_seconds']:.2f}",
+                ]
+            else:
+                row += ["-", "-", "-", "-"]
+        rows.append(row)
     widths = [
         max(len(h), *(len(row[i]) for row in rows)) if rows else len(h)
         for i, h in enumerate(headers)

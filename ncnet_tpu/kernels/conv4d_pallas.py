@@ -42,6 +42,14 @@ Round 3 closed the question of whether a redesigned kernel could win:
 The production answer is per-layer impl mixing in XLA ('tlc,btl4,tlc' —
 see bench.py). Kept as the interpret-verified scaffold and the record of
 WHY a hand kernel loses on this op/hardware pair.
+
+STATUS addendum (round 14): the conclusion above is specific to the
+DENSE packed layout, whose tap shifts have 1-row granularity. The
+sparse band's formulation (one pre-gathered GEMM per layer, PR 4) has
+no such shifts — its fused kernel (`band_gemm_pallas.py`, this
+directory) is the successor that DOES lower through Mosaic, and is
+production-dispatched via `band_impl='pallas'`. This file stays as the
+dense-path record and negative result.
 """
 
 import functools

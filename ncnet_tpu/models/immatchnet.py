@@ -77,6 +77,12 @@ class ImMatchNetConfig:
     # the per-cell capacity (better B-grid coverage for the inverse
     # readout direction); False is the plain per-A top-K.
     nc_topk_mutual: bool = True
+    # Sparse band NC layer backend: 'xla' (gather + GEMM composite) or
+    # 'pallas' (the fused gather+GEMM+bias+ReLU TPU kernel,
+    # ncnet_tpu/kernels/band_gemm_pallas.py — bitwise-equal VJP included;
+    # resolves back to 'xla' on non-TPU backends). Only consulted when
+    # nc_topk > 0.
+    band_impl: str = "xla"
 
     def replace(self, **kw):
         return dataclasses.replace(self, **kw)

@@ -13,6 +13,35 @@ Pure arithmetic over config-shaped integers; no jax, no module state
 # v5e bf16 peak per chip — the MFU denominator (bench.py's anchor).
 V5E_BF16_PEAK_FLOPS = 197e12
 
+# f32 anchor for the dual-MFU report: the v5e MXU has no native f32
+# multiply — XLA decomposes an f32 contraction into bf16 passes, so f32
+# compute tops out at roughly half the bf16 rate. A fixed convention,
+# not a datasheet number: the point of the pair is two STABLE
+# denominators so bf16 and f32 runs each get judged against the ceiling
+# their compute dtype can actually reach.
+V5E_F32_PEAK_FLOPS = V5E_BF16_PEAK_FLOPS / 2
+
+
+def compute_dtype(config):
+    """The step's contraction dtype as a string, from the model config.
+
+    ``half_precision`` runs features/correlation/NC in bf16 (master
+    params, loss, and optimizer state stay f32 — the mixed-precision
+    contract in train/step.py); everything else contracts in f32. This
+    is the dtype the MFU denominator must match.
+    """
+    return "bfloat16" if getattr(config, "half_precision", False) else "float32"
+
+
+def peak_flops(dtype):
+    """Per-chip peak for a compute dtype ('bfloat16' or 'float32') —
+    the denominator for that dtype's MFU."""
+    if dtype in ("bfloat16", "bf16"):
+        return V5E_BF16_PEAK_FLOPS
+    if dtype in ("float32", "f32"):
+        return V5E_F32_PEAK_FLOPS
+    raise ValueError(f"no peak-FLOPs anchor for compute dtype {dtype!r}")
+
 
 def trunk_forward_flops(cnn, image):
     """Trunk forward FLOPs (2*MACs) per image at ``image``x``image``.

@@ -242,6 +242,28 @@ def band_neighbor_pointers(indices, grid_b, kernel, swapped=False):
     return ptr.reshape(b, ha, wa, kslots, k1 * k2 * k3 * k4)
 
 
+def band_conv_gemm(x_entries, w, ptr):
+    """One submanifold conv pass: neighbour gather + one GEMM (no bias).
+
+    The primitive both band-conv backends share: the XLA path
+    (``ncnet_tpu/sparse/nc.py``) runs it as-is forward AND backward, the
+    fused Pallas kernel (``ncnet_tpu/kernels/band_gemm_pallas.py``) uses
+    it for its gather-only VJP — the backward must stay bitwise-identical
+    to the XLA path's, so there is exactly one definition of the
+    contraction (operand order included: XLA picks reduction strategies
+    per operand order, and the full-K bitwise contract holds against THIS
+    einsum).
+    """
+    cout = w.shape[-1]
+    g = band_gather_neighbors(x_entries, ptr)
+    return jnp.einsum(
+        "bnf,fo->bno",
+        g,
+        w.reshape(-1, cout).astype(x_entries.dtype),
+        preferred_element_type=x_entries.dtype,
+    )
+
+
 def band_gather_neighbors(x_entries, ptr):
     """Gather every band entry's conv-window neighbours as one dense block.
 

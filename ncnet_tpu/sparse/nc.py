@@ -28,19 +28,11 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
 from ncnet_tpu.analysis import sanitizer
-from ncnet_tpu.ops.band import band_gather_neighbors, band_neighbor_pointers
+from ncnet_tpu.ops.band import band_conv_gemm, band_neighbor_pointers
 
-
-def _band_conv_impl(x_entries, w, ptr):
-    """One submanifold conv pass: neighbour gather + one GEMM (no bias)."""
-    cout = w.shape[-1]
-    g = band_gather_neighbors(x_entries, ptr)
-    return jnp.einsum(
-        "bnf,fo->bno",
-        g,
-        w.reshape(-1, cout).astype(x_entries.dtype),
-        preferred_element_type=x_entries.dtype,
-    )
+# the gather+GEMM primitive lives in ops.band (shared with the fused
+# Pallas kernel's gather-only VJP — one definition of the contraction)
+_band_conv_impl = band_conv_gemm
 
 
 @jax.custom_vjp
@@ -96,7 +88,7 @@ _band_conv.defvjp(_band_conv_fwd, _band_conv_bwd)
 
 
 def sparse_neigh_consensus_apply(params, values, indices, grid_b,
-                                 symmetric=True):
+                                 symmetric=True, band_impl="xla"):
     """Filter a correlation band with the learned NC stack.
 
     Args:
@@ -109,6 +101,13 @@ def sparse_neigh_consensus_apply(params, values, indices, grid_b,
       symmetric: reference ``symmetric_mode`` — adds the transposed-pass
         term via the swapped-tap gather (works for rectangular A/B grids
         too: nothing is ever transposed, only tap roles).
+      band_impl: ``'xla'`` (default: the eager gather+GEMM composite) or
+        ``'pallas'`` — the fused gather+GEMM+bias+ReLU TPU kernel
+        (``ncnet_tpu/kernels/band_gemm_pallas.py``). ``'pallas'`` on a
+        non-TPU backend silently resolves back to ``'xla'`` (the serve /
+        recompile contracts never see a broken lowering); set
+        ``NCNET_BAND_PALLAS_INTERPRET=1`` to force the kernel through
+        the Pallas interpreter instead (CPU integration tests).
 
     Returns:
       ``[b, hA, wA, K]`` filtered band on the SAME support (submanifold
@@ -117,6 +116,25 @@ def sparse_neigh_consensus_apply(params, values, indices, grid_b,
     dtype = values.dtype
     b, ha, wa, k = values.shape
     n = ha * wa * k
+
+    if band_impl not in ("xla", "pallas"):
+        raise ValueError(
+            f"band_impl={band_impl!r}: expected 'xla' or 'pallas'"
+        )
+    fused_band = None
+    if band_impl == "pallas":
+        from ncnet_tpu.kernels.band_gemm_pallas import (
+            band_conv_bias_relu_pallas,
+            resolve_band_impl,
+        )
+
+        if resolve_band_impl(band_impl) != "xla":
+            interpret = resolve_band_impl(band_impl) == "pallas_interpret"
+
+            def fused_band(xp, w, bias, ptr):
+                return band_conv_bias_relu_pallas(
+                    xp, w, bias, ptr, interpret=interpret
+                )
 
     ptr_cache = {}
 
@@ -132,15 +150,25 @@ def sparse_neigh_consensus_apply(params, values, indices, grid_b,
         xp = x_entries
         for li, p in enumerate(params):
             w = p["kernel"]
-            y = _band_conv(xp, w, ptr_for(tuple(w.shape[:4])))
-            # params follow the activation dtype and the bias is added
-            # once, exactly like the dense conv4d layers
-            y = y + p["bias"].astype(dtype)
-            # same save-policy tag as the dense stack: the loss-chunk
-            # remat saves these GEMM outputs and recomputes only the
-            # cheap elementwise rest (train/loss.py)
-            y = checkpoint_name(y, "nc_conv")
-            xp = jax.nn.relu(y)
+            if fused_band is not None:
+                # one fused kernel per layer: gather + GEMM + bias + ReLU
+                # never round-trip through HBM; the save-policy tag moves
+                # to the post-ReLU activation (the pre-activation never
+                # exists as a program value)
+                xp = fused_band(
+                    xp, w, p["bias"], ptr_for(tuple(w.shape[:4]))
+                )
+                xp = checkpoint_name(xp, "nc_conv")
+            else:
+                y = _band_conv(xp, w, ptr_for(tuple(w.shape[:4])))
+                # params follow the activation dtype and the bias is
+                # added once, exactly like the dense conv4d layers
+                y = y + p["bias"].astype(dtype)
+                # same save-policy tag as the dense stack: the loss-chunk
+                # remat saves these GEMM outputs and recomputes only the
+                # cheap elementwise rest (train/loss.py)
+                y = checkpoint_name(y, "nc_conv")
+                xp = jax.nn.relu(y)
             xp = sanitizer.tap(f"nc_layer{li}{tag}", xp)
         return xp
 

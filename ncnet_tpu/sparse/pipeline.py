@@ -69,6 +69,7 @@ def sparse_match_pipeline(nc_params, config, feat_a, feat_b):
     band = sparse_neigh_consensus_apply(
         nc_params, values, indices, grid_b,
         symmetric=config.symmetric_mode,
+        band_impl=getattr(config, "band_impl", "xla"),
     )
     band = sanitizer.tap("neigh_consensus", band)
     band = sanitizer.tap(

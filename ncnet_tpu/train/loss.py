@@ -9,6 +9,13 @@ negative pairs formed by rolling the source-image batch by one
 The reference mutates the batch in place to build negatives; here the roll
 is applied functionally to the *extracted source features* (identical result
 — the backbone is deterministic — at half the backbone cost).
+
+Mixed precision (``config.half_precision``, see train/step.py for the
+full contract): the pipeline contracts in bf16 but BOTH pipelines cast
+back to f32 at the post-NC mutual-matching boundary, so the score
+normalization, the per-sample means, and the final ``neg - pos``
+reduction — everything a tiny loss difference must survive — run in
+f32. The bf16 region is exactly the MXU-heavy middle.
 """
 
 import jax
@@ -90,8 +97,18 @@ def weak_loss_from_features(params, config, batch, normalization="softmax"):
     go stale after the first optimizer step (train/step.py raises before
     tracing ever gets here).
     """
-    feat_a = sanitizer.tap("features", batch["source_features"])
-    feat_b = sanitizer.tap("features", batch["target_features"])
+    feat_a = batch["source_features"]
+    feat_b = batch["target_features"]
+    if config.half_precision:
+        # mirror extract_features' dtype policy: a bf16-config store
+        # already shards bf16 (no-op cast), but an f32 feature batch
+        # handed to a bf16 config would otherwise run the correlation —
+        # the step's FIRST contraction — in f32, which the audit's
+        # bf16-promotion-drift gate flags on the declared-bf16 programs
+        feat_a = feat_a.astype(jnp.bfloat16)
+        feat_b = feat_b.astype(jnp.bfloat16)
+    feat_a = sanitizer.tap("features", feat_a)
+    feat_b = sanitizer.tap("features", feat_b)
     return weak_loss_core(
         params["neigh_consensus"], config, feat_a, feat_b, normalization
     )

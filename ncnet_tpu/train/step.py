@@ -182,6 +182,19 @@ def make_train_step(
     program contains no f64 values and no host callbacks, and its
     walked dot/conv FLOPs must equal ``ops.accounting.train_step_flops``
     exactly (the telemetry MFU numerator).
+
+    Mixed-precision contract (``config.half_precision``, the default
+    train path): features, correlation, and the NC stack compute in
+    bf16 — every MXU contraction — while the MASTER params, the loss
+    reduction, the gradients as applied, and the optimizer state stay
+    f32. The cast happens on the way INTO the pipeline (features /
+    correlation values); gradients arriving back at the f32 params are
+    accumulated and applied in f32, so repeated tiny updates are not
+    swallowed by bf16's 8-bit mantissa. Checkpoints therefore always
+    hold f32 weights — bf16 and f32 runs load each other's checkpoints
+    freely. Verified by the ``train/*-bf16`` audit programs
+    (``bf16-promotion-drift`` gate) and the 3-step drill in
+    tests/test_train.py.
     """
     check_sparse_config(config)
     if from_features:

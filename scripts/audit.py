@@ -31,6 +31,7 @@ from ncnet_tpu.analysis.findings import (  # noqa: E402
     format_sarif,
     format_text,
 )
+from ncnet_tpu.analysis.hlo_audit import HLO_RULES  # noqa: E402
 from ncnet_tpu.analysis.jaxpr_audit import (  # noqa: E402
     JAXPR_RULES,
     PROGRAMS,
@@ -62,7 +63,11 @@ def main(argv=None):
     p.add_argument("--list-programs", action="store_true",
                    help="print the entry-program registry and exit")
     p.add_argument("--list-rules", action="store_true",
-                   help="print the jaxpr rule catalog and exit")
+                   help="print the jaxpr + HLO rule catalog and exit")
+    p.add_argument("--no-hlo", action="store_true",
+                   help="skip the HLO-level pass (no compilation: trace-"
+                        "only jaxpr rules, faster but blind to lowering "
+                        "regressions)")
     args = p.parse_args(argv)
 
     if args.list_programs:
@@ -73,7 +78,8 @@ def main(argv=None):
                 print(f"  waived {rule_id}: {reason}")
         return 0
     if args.list_rules:
-        for r in sorted(JAXPR_RULES.values(), key=lambda r: r.rule_id):
+        catalog = list(JAXPR_RULES.values()) + list(HLO_RULES.values())
+        for r in sorted(catalog, key=lambda r: r.rule_id):
             print(f"{r.rule_id} ({r.severity}): {' '.join(r.doc.split())}")
         return 0
 
@@ -87,12 +93,15 @@ def main(argv=None):
     selected = None
     if args.select:
         selected = [s.strip() for s in args.select.split(",") if s.strip()]
-        unknown = [s for s in selected if s not in JAXPR_RULES]
+        unknown = [
+            s for s in selected
+            if s not in JAXPR_RULES and s not in HLO_RULES
+        ]
         if unknown:
             p.error(f"unknown rule id(s): {', '.join(unknown)} "
                     f"(see --list-rules)")
 
-    result = audit(programs, selected)
+    result = audit(programs, selected, hlo=not args.no_hlo)
     findings = result.all_findings
 
     if args.fmt == "json":

@@ -31,6 +31,12 @@ def main():
     p.add_argument("--pano_path", type=str, default="datasets/inloc/pano/")
     p.add_argument("--query_path", type=str, default="datasets/inloc/query/iphone7/")
     p.add_argument("--output_root", type=str, default="matches")
+    p.add_argument("--bf16", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="bf16 features/correlation/NC compute — the "
+                        "reference eval's fp16 memory toolkit, TPU-native "
+                        "(default ON: the 3200px pooled correlation does "
+                        "not fit in f32); --no-bf16 runs full f32")
     p.add_argument("--conv4d_impl", type=str, default="cfs",
                    help="conv4d lowering for the eval forward (overrides "
                         "the checkpoint's training-time choice, which is "
@@ -112,7 +118,7 @@ def main():
     # k=2 vs btl4 2.55 and 'scan' 14.6; 'xla'/'tf3'/'btl2'/'btl6' fail
     # to compile at this shape (benchmarks/micro_inloc.py).
     config = config.replace(
-        half_precision=True,
+        half_precision=args.bf16,
         relocalization_k_size=args.k_size,
         conv4d_impl=args.conv4d_impl,
     )

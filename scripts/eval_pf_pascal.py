@@ -23,6 +23,12 @@ def main():
                         "sequential path (padding masked at readout, "
                         "tests/test_serve.py). 0 = sequential "
                         "per-loader-batch eval")
+    p.add_argument("--bf16", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="bf16 features/correlation/NC compute for the "
+                        "eval forward (readout stays f32). Default: the "
+                        "checkpoint's recorded dtype; --bf16 / --no-bf16 "
+                        "override in either direction")
     p.add_argument("--conv4d_impl", type=str, default="tlc",
                    help="conv4d lowering for the eval forward (overrides "
                         "the checkpoint's training-tuned mix, whose "
@@ -50,6 +56,8 @@ def main():
 
     if args.conv4d_impl:
         config = config.replace(conv4d_impl=args.conv4d_impl)
+    if args.bf16 is not None:
+        config = config.replace(half_precision=args.bf16)
 
     dataset = PFPascalDataset(
         os.path.join(args.eval_dataset_path, "image_pairs", "test_pairs.csv"),

@@ -121,6 +121,13 @@ def parse_args(argv=None):
     p.add_argument("--nc-topk", type=int, default=-1,
                    help="override config.nc_topk (sparse NC band; -1 keeps "
                         "the checkpoint's setting)")
+    p.add_argument("--bf16", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="bf16 features/correlation/NC compute for the "
+                        "serving forward (readout stays f32). Default: "
+                        "the checkpoint's recorded dtype; --bf16 / "
+                        "--no-bf16 override in either direction (master "
+                        "weights are f32 either way)")
     p.add_argument("--conv4d_impl", type=str, default="tlc",
                    help="conv4d lowering for the serving forward (empty "
                         "keeps the checkpoint's; 'tlc' measured fastest "
@@ -301,6 +308,8 @@ def _run(args, telemetry):
         config = config.replace(conv4d_impl=args.conv4d_impl)
     if args.nc_topk >= 0:
         config = config.replace(nc_topk=args.nc_topk)
+    if args.bf16 is not None:
+        config = config.replace(half_precision=args.bf16)
 
     requests = load_requests(args)
     spec = BucketSpec(args.image_size, max(config.relocalization_k_size, 1))

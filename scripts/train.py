@@ -127,7 +127,15 @@ def main():
                         "~68 images/s; the IVD config consumes ~240 — "
                         "PERF.md)")
     p.add_argument("--seed", type=int, default=1)
-    p.add_argument("--bf16", action="store_true", help="bfloat16 compute path")
+    p.add_argument("--bf16", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="bf16 features/correlation/NC compute with f32 "
+                        "master params and f32 loss/optimizer state (see "
+                        "train/step.py). Default ON for fresh runs — the "
+                        "raw-speed train path; a resume keeps the "
+                        "checkpoint's recorded dtype unless the flag is "
+                        "given explicitly (--bf16 / --no-bf16 override "
+                        "in either direction)")
     p.add_argument("--sanitize", action="store_true",
                    help="enable the numerical sanitizer "
                         "(ncnet_tpu.analysis.sanitizer): per-stage "
@@ -312,7 +320,7 @@ def main():
         config, params = convert_checkpoint(args.checkpoint)
         chunk = args.loss_chunk or 0
         config = config.replace(
-            half_precision=args.bf16,
+            half_precision=(True if args.bf16 is None else args.bf16),
             conv4d_impl=args.conv4d_impl
             or default_impl(len(config.ncons_channels)),
             loss_chunk=chunk, nc_remat=chunk == 0,
@@ -355,6 +363,10 @@ def main():
             config = config.replace(nc_topk=args.nc_topk)
         if args.nc_topk_mutual is not None:
             config = config.replace(nc_topk_mutual=args.nc_topk_mutual)
+        if args.bf16 is not None:  # explicit flag overrides the
+            # checkpoint's compute dtype in either direction (master
+            # params are f32 in both modes, so the weights are portable)
+            config = config.replace(half_precision=args.bf16)
         # the checkpoint records WHICH params were training (the opt-state
         # pytree shape depends on it); default flags adopt its mode, an
         # explicit different mode restarts the optimizer
@@ -402,7 +414,7 @@ def main():
             feature_extraction_cnn=args.fe_arch,
             ncons_kernel_sizes=tuple(args.ncons_kernel_sizes),
             ncons_channels=tuple(args.ncons_channels),
-            half_precision=args.bf16,
+            half_precision=(True if args.bf16 is None else args.bf16),
             conv4d_impl=args.conv4d_impl
             or default_impl(len(args.ncons_channels)),
             loss_chunk=args.loss_chunk or 0,

@@ -423,3 +423,154 @@ def test_nclint_sarif_output(tmp_path, capsys):
     doc = json.loads(capsys.readouterr().out)
     assert doc["runs"][0]["tool"]["driver"]["name"] == "nclint"
     assert doc["runs"][0]["results"][0]["ruleId"] == "mutable-default-arg"
+
+
+# --- HLO-level pass (ncnet_tpu.analysis.hlo_audit) ---------------------------
+
+
+def _hlo_program(**kw):
+    """A synthetic HloProgram for golden rule tests (no compile)."""
+    from ncnet_tpu.analysis.hlo_audit import HloProgram
+
+    base = dict(
+        name="synthetic", built=None, entry_ops={"fusion": 10, "dot": 2},
+        contractions=2, peak_bytes_est=1000, bytes_in=1000,
+        hlo_temp_bytes=None,
+    )
+    base.update(kw)
+    return HloProgram(**base)
+
+
+def test_hlo_rule_catalog_and_meta():
+    from ncnet_tpu.analysis.hlo_audit import HLO_RULES
+
+    assert set(HLO_RULES) == {
+        "fusion-fragmentation", "layout-churn", "memory-highwater"
+    }
+    meta = rules_meta()
+    for rid in HLO_RULES:
+        assert rid in meta and meta[rid]["doc"]
+    assert "audit-compile-failure" in meta
+
+
+def test_fusion_fragmentation_golden_and_clean(monkeypatch):
+    from ncnet_tpu.analysis import hlo_audit
+    from ncnet_tpu.analysis.hlo_audit import run_hlo_rules
+
+    hp = _hlo_program(
+        entry_ops={"fusion": 50, "dot": 2, "parameter": 5}, contractions=2
+    )
+    monkeypatch.setattr(hlo_audit, "FRAGMENTATION_OPS_PER_CONTRACTION", 10.0)
+    monkeypatch.setattr(hlo_audit, "FRAGMENTATION_MIN_OPS", 1)
+    findings, _ = run_hlo_rules(hp)
+    assert [f.rule for f in findings] == ["fusion-fragmentation"]
+    assert findings[0].path == "hlo:synthetic"
+    assert findings[0].detail["launches"] == 52  # parameters are free
+    # clean twin: same census, budget above the ratio
+    monkeypatch.setattr(hlo_audit, "FRAGMENTATION_OPS_PER_CONTRACTION", 100.0)
+    assert run_hlo_rules(hp) == ([], [])
+    # tiny programs never fire regardless of ratio
+    monkeypatch.setattr(hlo_audit, "FRAGMENTATION_OPS_PER_CONTRACTION", 0.1)
+    monkeypatch.setattr(hlo_audit, "FRAGMENTATION_MIN_OPS", 1000)
+    assert run_hlo_rules(hp) == ([], [])
+
+
+def test_layout_churn_golden_and_clean(monkeypatch):
+    from ncnet_tpu.analysis import hlo_audit
+    from ncnet_tpu.analysis.hlo_audit import run_hlo_rules
+
+    hp = _hlo_program(
+        entry_ops={"fusion": 10, "transpose": 6, "copy": 3}, contractions=5
+    )
+    monkeypatch.setattr(hlo_audit, "LAYOUT_CHURN_MIN_OPS", 4)
+    monkeypatch.setattr(hlo_audit, "LAYOUT_CHURN_FRACTION", 0.0)
+    findings, _ = run_hlo_rules(hp, rules=["layout-churn"])
+    assert [f.rule for f in findings] == ["layout-churn"]
+    assert findings[0].detail == {
+        "transpose": 6, "copy": 3, "entry_ops": 19, "budget": 4,
+    }
+    # the budget is the MAX of the floor and the fraction term
+    monkeypatch.setattr(hlo_audit, "LAYOUT_CHURN_FRACTION", 1.0)
+    assert run_hlo_rules(hp, rules=["layout-churn"]) == ([], [])
+
+
+def test_memory_highwater_golden_and_clean(monkeypatch):
+    from ncnet_tpu.analysis import hlo_audit
+    from ncnet_tpu.analysis.hlo_audit import run_hlo_rules
+
+    hp = _hlo_program(peak_bytes_est=5000, bytes_in=1000)
+    monkeypatch.setattr(hlo_audit, "MEM_HIGHWATER_ABS_FLOOR", 100)
+    monkeypatch.setattr(hlo_audit, "MEM_HIGHWATER_INPUT_RATIO", 2.0)
+    findings, _ = run_hlo_rules(hp, rules=["memory-highwater"])
+    assert [f.rule for f in findings] == ["memory-highwater"]
+    assert findings[0].detail["budget"] == 2000
+    monkeypatch.setattr(hlo_audit, "MEM_HIGHWATER_INPUT_RATIO", 10.0)
+    assert run_hlo_rules(hp, rules=["memory-highwater"]) == ([], [])
+
+
+def test_hlo_waiver_moves_finding_aside(monkeypatch):
+    from ncnet_tpu.analysis import hlo_audit
+    from ncnet_tpu.analysis.hlo_audit import run_hlo_rules
+
+    hp = _hlo_program(peak_bytes_est=5000, bytes_in=1000)
+    monkeypatch.setattr(hlo_audit, "MEM_HIGHWATER_ABS_FLOOR", 100)
+    monkeypatch.setattr(hlo_audit, "MEM_HIGHWATER_INPUT_RATIO", 2.0)
+    findings, waived = run_hlo_rules(
+        hp, waivers={"memory-highwater": "known gather transient"}
+    )
+    assert findings == []
+    assert [f.rule for f in waived] == ["memory-highwater"]
+
+
+def test_parse_entry_opcodes_excludes_fusion_bodies():
+    from ncnet_tpu.analysis.hlo_audit import parse_entry_opcodes
+
+    hlo = """\
+HloModule jit_f
+
+%fused_computation (p0: f32[4]) -> f32[4] {
+  %p0 = f32[4] parameter(0)
+  %t = f32[4] transpose(%p0), dimensions={0}
+  ROOT %m = f32[4] multiply(%t, %t)
+}
+
+ENTRY %main (a: f32[4]) -> (f32[4]) {
+  %a = f32[4] parameter(0)
+  %fus = f32[4] fusion(%a), kind=kLoop, calls=%fused_computation
+  %d = f32[4] add(%fus, %a)
+  ROOT %out = (f32[4]) tuple(%d)
+}
+"""
+    ops = parse_entry_opcodes(hlo)
+    # the transpose/multiply live INSIDE the fusion body — not launches
+    assert ops == {"parameter": 1, "fusion": 1, "add": 1, "tuple": 1}
+    with pytest.raises(ValueError, match="ENTRY"):
+        parse_entry_opcodes("HloModule empty")
+
+
+def test_jaxpr_memory_highwater_linear_chain():
+    """x -> y -> z chain of [4,4] f32: peak is two 64-byte buffers live
+    across one equation (alloc-at-def, free-after-last-use)."""
+    from ncnet_tpu.analysis.hlo_audit import jaxpr_memory_highwater
+
+    def f(x):
+        y = x * 2.0
+        return y + 1.0
+
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros((4, 4), jnp.float32)).jaxpr
+    assert jaxpr_memory_highwater(jaxpr) == 128
+
+
+def test_audit_hlo_integration_real_program():
+    """The end-to-end HLO pass on a real registered program: compiles,
+    reports the HLO columns, and is finding-free at the seed budgets."""
+    result = audit(["eval/match"], hlo=True)
+    assert result.all_findings == []
+    (report,) = [r for r in result.reports if r["program"] == "eval/match"]
+    for key in ("hlo_entry_ops", "hlo_fusions", "hlo_churn",
+                "mem_highwater_est", "compile_seconds"):
+        assert key in report, key
+    assert report["hlo_entry_ops"] > 0
+    assert report["mem_highwater_est"] > 0
+    table = format_report_table(result.reports)
+    assert "fusions" in table and "mem(hw)" in table

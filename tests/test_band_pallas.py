@@ -1,0 +1,284 @@
+"""Fused Pallas band-GEMM kernel (ncnet_tpu/kernels/band_gemm_pallas.py).
+
+The contract under test, in interpret mode (CPU-exact emulation of the
+kernel's arithmetic): the fused gather+GEMM+bias+ReLU layer and its
+gather-only custom VJP are BITWISE-equal in eager mode to the XLA band
+composite (`sparse.nc._band_conv` + bias + relu — the production path
+whose backward is the shared `ops.band.band_conv_gemm` einsum), in f32
+AND bf16, symmetric on/off, on rectangular grids and at full K where
+the dense gemm4 lowering is the oracle. Under jit the whole-pipeline
+contract relaxes to ULP-allclose (XLA refuses to promise fusion-order
+stability; the chunked/remat path happens to stay bitwise and is pinned
+as such). Dispatch: `resolve_band_impl` must fall back to 'xla' off-TPU
+so a TPU-trained band_impl='pallas' checkpoint serves anywhere.
+"""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.kernels.band_gemm_pallas import (
+    band_conv_bias_relu_pallas,
+    resolve_band_impl,
+)
+from ncnet_tpu.models.immatchnet import (
+    ImMatchNetConfig,
+    init_immatchnet,
+    match_pipeline,
+)
+from ncnet_tpu.ops.band import band_neighbor_pointers, topk_band
+from ncnet_tpu.sparse.nc import _band_conv
+from ncnet_tpu.train.loss import weak_loss_core
+
+BASE = dict(ncons_kernel_sizes=(3, 3), ncons_channels=(4, 1))
+
+
+def _band_inputs(rng, b, ha, wa, hb, wb, K, cin, k, dtype=jnp.float32):
+    """A random band + pointer table + layer weights at one geometry."""
+    scores = jnp.asarray(
+        rng.randn(b, ha, wa, hb, wb).astype(np.float32)
+    )
+    _, indices = topk_band(scores, K)
+    n = ha * wa * min(K, hb * wb)
+    x = jnp.asarray(rng.randn(b, n, cin).astype(np.float32), dtype)
+    ptr = band_neighbor_pointers(indices, (hb, wb), (k, k, k, k))
+    w = jnp.asarray(
+        rng.randn(k, k, k, k, cin, cin) * (cin * k**4) ** -0.5, dtype
+    )
+    bias = jnp.asarray(rng.randn(cin) * 0.01, dtype)
+    return x, ptr.reshape(b, n, -1), w, bias
+
+
+def _xla_layer(x, w, bias, ptr):
+    return jax.nn.relu(_band_conv(x, w, ptr) + bias.astype(x.dtype))
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_forward_bitwise_eager(dtype):
+    rng = np.random.RandomState(0)
+    x, ptr, w, bias = _band_inputs(rng, 2, 4, 4, 4, 4, 6, 3, 3, dtype)
+    out_k = band_conv_bias_relu_pallas(x, w, bias, ptr, interpret=True)
+    out_x = _xla_layer(x, w, bias, ptr)
+    assert out_k.dtype == out_x.dtype
+    np.testing.assert_array_equal(np.asarray(out_k), np.asarray(out_x))
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_vjp_bitwise_eager(dtype):
+    """dx, dw, db all bitwise vs the XLA composite's custom VJP — the
+    invariant that lets checkpoints hop between backends mid-training."""
+    rng = np.random.RandomState(1)
+    x, ptr, w, bias = _band_inputs(rng, 2, 4, 4, 4, 4, 6, 3, 3, dtype)
+
+    def loss_k(x, w, bias):
+        y = band_conv_bias_relu_pallas(x, w, bias, ptr, interpret=True)
+        return jnp.sum(y.astype(jnp.float32))
+
+    def loss_x(x, w, bias):
+        return jnp.sum(_xla_layer(x, w, bias, ptr).astype(jnp.float32))
+
+    gk = jax.grad(loss_k, argnums=(0, 1, 2))(x, w, bias)
+    gx = jax.grad(loss_x, argnums=(0, 1, 2))(x, w, bias)
+    for a, b, nm in zip(gk, gx, ("dx", "dw", "db")):
+        assert a.dtype == b.dtype, nm
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=nm)
+
+
+def test_rectangular_grid_and_partial_band():
+    """Non-square A and B grids with K < hB*wB (padding rows in play)."""
+    rng = np.random.RandomState(2)
+    x, ptr, w, bias = _band_inputs(rng, 2, 3, 5, 4, 2, 5, 3, 3)
+    out_k = band_conv_bias_relu_pallas(x, w, bias, ptr, interpret=True)
+    out_x = _xla_layer(x, w, bias, ptr)
+    np.testing.assert_array_equal(np.asarray(out_k), np.asarray(out_x))
+
+
+def test_small_block_rows_padding_path():
+    """block_rows smaller than the entry count exercises the grid loop
+    AND the ptr-row padding (n not a multiple of the block)."""
+    rng = np.random.RandomState(3)
+    x, ptr, w, bias = _band_inputs(rng, 1, 3, 3, 3, 3, 5, 2, 3)
+    out_ref = band_conv_bias_relu_pallas(x, w, bias, ptr, interpret=True)
+    out_blk = band_conv_bias_relu_pallas(
+        x, w, bias, ptr, interpret=True, block_rows=7
+    )
+    np.testing.assert_array_equal(np.asarray(out_blk), np.asarray(out_ref))
+
+
+def test_even_kernel_backward_raises():
+    rng = np.random.RandomState(4)
+    x, ptr, w, bias = _band_inputs(rng, 1, 3, 3, 3, 3, 4, 2, 2)
+
+    def loss(x):
+        return jnp.sum(
+            band_conv_bias_relu_pallas(x, w, bias, ptr, interpret=True)
+        )
+
+    with pytest.raises(ValueError, match="odd"):
+        jax.grad(loss)(x)
+
+
+# --- pipeline integration: full-K exactness + jit ULP contract ---------------
+
+
+@pytest.mark.parametrize("symmetric", [True, False])
+def test_full_k_pipeline_bitwise_eager(symmetric):
+    """band_impl='pallas' (interpret) vs 'xla' through the WHOLE sparse
+    pipeline at full K, symmetric on and off."""
+    cfg = ImMatchNetConfig(
+        nc_topk=16, symmetric_mode=symmetric, **BASE
+    )
+    rng = np.random.RandomState(5)
+    fa = jnp.asarray(rng.randn(2, 4, 4, 7).astype(np.float32))
+    fb = jnp.asarray(rng.randn(2, 4, 4, 7).astype(np.float32))
+    params = init_immatchnet(jax.random.PRNGKey(0), cfg)
+    nc = params["neigh_consensus"]
+    out_x = np.asarray(match_pipeline(nc, cfg, fa, fb))
+    with _force_interpret():
+        out_p = np.asarray(
+            match_pipeline(nc, cfg.replace(band_impl="pallas"), fa, fb)
+        )
+    np.testing.assert_array_equal(out_x, out_p)
+
+
+def test_three_training_steps_bitwise_eager():
+    """3 optimizer steps on the band loss: identical NC params and losses
+    whether the layers run through XLA or the fused kernel."""
+    cfg = ImMatchNetConfig(nc_topk=4, **BASE)
+    rng = np.random.RandomState(6)
+    fa = jnp.asarray(rng.randn(2, 4, 4, 7).astype(np.float32))
+    fb = jnp.asarray(rng.randn(2, 4, 4, 7).astype(np.float32))
+    params = init_immatchnet(jax.random.PRNGKey(0), cfg)
+
+    def train3(c):
+        nc = params["neigh_consensus"]
+        opt = optax.adam(5e-4)
+        st = opt.init(nc)
+        losses = []
+        for _ in range(3):
+            loss, g = jax.value_and_grad(
+                lambda p: weak_loss_core(p, c, fa, fb)
+            )(nc)
+            up, st2 = opt.update(g, st, nc)
+            st = st2
+            nc = optax.apply_updates(nc, up)
+            losses.append(np.asarray(loss))
+        return losses, nc
+
+    losses_x, nc_x = train3(cfg)
+    with _force_interpret():
+        losses_p, nc_p = train3(cfg.replace(band_impl="pallas"))
+    np.testing.assert_array_equal(losses_x, losses_p)
+    for va, vb in zip(
+        jax.tree_util.tree_leaves(nc_x), jax.tree_util.tree_leaves(nc_p)
+    ):
+        np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+
+
+def test_jitted_loss_ulp_allclose():
+    """Under jit the contract is ULP-allclose: XLA's fusion choices may
+    differ by 1 ulp between the two band lowerings (the chunked/remat
+    production path stays bitwise — pinned in the chunked variant)."""
+    cfg = ImMatchNetConfig(nc_topk=4, **BASE)
+    rng = np.random.RandomState(7)
+    fa = jnp.asarray(rng.randn(2, 4, 4, 7).astype(np.float32))
+    fb = jnp.asarray(rng.randn(2, 4, 4, 7).astype(np.float32))
+    params = init_immatchnet(jax.random.PRNGKey(0), cfg)
+    nc = params["neigh_consensus"]
+
+    def loss(c):
+        return jax.jit(
+            lambda p: weak_loss_core(p, c, fa, fb)
+        )(nc)
+
+    l_x = np.asarray(loss(cfg))
+    with _force_interpret():
+        l_p = np.asarray(loss(cfg.replace(band_impl="pallas")))
+    np.testing.assert_allclose(l_p, l_x, rtol=1e-6, atol=1e-7)
+
+
+def test_jitted_chunked_loss_bitwise():
+    cfg = ImMatchNetConfig(nc_topk=4, loss_chunk=1, **BASE)
+    rng = np.random.RandomState(8)
+    fa = jnp.asarray(rng.randn(2, 4, 4, 7).astype(np.float32))
+    fb = jnp.asarray(rng.randn(2, 4, 4, 7).astype(np.float32))
+    params = init_immatchnet(jax.random.PRNGKey(0), cfg)
+    nc = params["neigh_consensus"]
+
+    def loss(c):
+        return np.asarray(
+            jax.jit(lambda p: weak_loss_core(p, c, fa, fb))(nc)
+        )
+
+    with _force_interpret():
+        l_p = loss(cfg.replace(band_impl="pallas"))
+    np.testing.assert_array_equal(loss(cfg), l_p)
+
+
+# --- dispatch ----------------------------------------------------------------
+
+
+def _force_interpret():
+    """Route band_impl='pallas' to the interpret kernel on this CPU host
+    (the env knob the STATUS docs as the off-TPU validation path)."""
+    import os
+    from contextlib import contextmanager
+
+    @contextmanager
+    def ctx():
+        os.environ["NCNET_BAND_PALLAS_INTERPRET"] = "1"
+        try:
+            yield
+        finally:
+            os.environ.pop("NCNET_BAND_PALLAS_INTERPRET", None)
+
+    return ctx()
+
+
+def test_resolve_band_impl_fallback():
+    """Off-TPU, 'pallas' resolves to 'xla' (clean serving fallback);
+    the interpret env knob opts into the emulated kernel; 'xla' is
+    always itself."""
+    assert resolve_band_impl("xla") == "xla"
+    if jax.default_backend() != "tpu":
+        assert resolve_band_impl("pallas") == "xla"
+        with _force_interpret():
+            assert resolve_band_impl("pallas") == "pallas_interpret"
+
+
+def test_pipeline_pallas_config_falls_back_cleanly():
+    """A band_impl='pallas' config must run (via the XLA fallback) on a
+    non-TPU host without the env knob — TPU-trained checkpoints stay
+    servable anywhere, bitwise-identically to 'xla'."""
+    cfg = ImMatchNetConfig(nc_topk=4, band_impl="pallas", **BASE)
+    rng = np.random.RandomState(9)
+    fa = jnp.asarray(rng.randn(1, 4, 4, 7).astype(np.float32))
+    fb = jnp.asarray(rng.randn(1, 4, 4, 7).astype(np.float32))
+    params = init_immatchnet(jax.random.PRNGKey(0), cfg)
+    nc = params["neigh_consensus"]
+    out_p = np.asarray(match_pipeline(nc, cfg, fa, fb))
+    out_x = np.asarray(
+        match_pipeline(nc, cfg.replace(band_impl="xla"), fa, fb)
+    )
+    np.testing.assert_array_equal(out_p, out_x)
+
+
+def test_config_rejects_unknown_band_impl():
+    cfg = ImMatchNetConfig(nc_topk=4, band_impl="mosaic", **BASE)
+    rng = np.random.RandomState(10)
+    fa = jnp.asarray(rng.randn(1, 4, 4, 7).astype(np.float32))
+    params = init_immatchnet(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="band_impl"):
+        match_pipeline(params["neigh_consensus"], cfg, fa, fa)
+
+
+def test_config_roundtrip_keeps_band_impl():
+    cfg = ImMatchNetConfig(nc_topk=4, band_impl="pallas", **BASE)
+    assert ImMatchNetConfig.from_dict(cfg.to_dict()).band_impl == "pallas"
+    # legacy checkpoint dicts (no band_impl key) get the default
+    d = cfg.to_dict()
+    d.pop("band_impl")
+    assert ImMatchNetConfig.from_dict(d).band_impl == "xla"

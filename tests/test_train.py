@@ -268,6 +268,51 @@ def test_chunked_loss_with_save_policy_matches_unchunked():
         )
 
 
+def test_bf16_three_step_drill_f32_master_params():
+    """The mixed-precision contract of the default train path (see
+    make_train_step): 3 bf16 steps with the sanitizer armed — every
+    staged probe finite, the loss f32 and finite each step, and the
+    MASTER params + optimizer state f32 throughout (bf16 lives only
+    inside the pipeline; checkpoints never hold bf16 weights)."""
+    from ncnet_tpu.analysis import sanitizer
+
+    cfg = CFG.replace(half_precision=True)
+    params = init_immatchnet(jax.random.PRNGKey(3), cfg)
+    opt = make_optimizer(1e-3)
+    state = create_train_state(params, opt)
+    batch = _batch(np.random.RandomState(3))
+    sanitizer.clear(stage_order=True)
+    sanitizer.enable()
+    try:
+        step = make_train_step(cfg, opt, donate=False)
+        for i in range(3):
+            state, loss = step(state, batch)
+            loss_host = np.asarray(loss)
+            assert loss_host.dtype == np.float32
+            assert np.isfinite(float(loss_host)), f"step {i}"
+        jax.block_until_ready(state)
+        assert sanitizer.first_nonfinite() is None, sanitizer.report_text()
+        assert any(
+            r["stage"] == "features" for r in sanitizer.reports()
+        ), "bf16 pipeline probes never fired"
+    finally:
+        sanitizer.enable(False)
+        sanitizer.clear(stage_order=True)
+    for leaf in jax.tree.leaves(state.params):
+        assert leaf.dtype == jnp.float32
+    for leaf in jax.tree.leaves(state.opt_state):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32
+    # and the params actually moved — the f32 masters are being trained
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree.leaves(params["neigh_consensus"]),
+            jax.tree.leaves(state.params["neigh_consensus"]),
+        )
+    )
+
+
 def test_synthetic_convergence_slow():
     """End-to-end learning proof (VERDICT r1 item 3): loss decreases and
     the synthetic keypoint-transfer PCK improves over training. Slow
