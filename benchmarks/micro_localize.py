@@ -129,6 +129,75 @@ def bench_pnp(serial=False):
     return out
 
 
+def bench_jax_batched(reps=3):
+    """NumPy-sequential vs jax-batched fixed-schedule RANSAC, poses/s.
+
+    CPU proxy of the serving geometry (round 15): tentatives padded to a
+    pose bucket, STATIC hypothesis count, batch axis = queries — the
+    exact program `ncnet_tpu.localize.request` serves. Both sides run
+    the same fixed schedule (score-all-then-argmax + LO refits), so the
+    comparison isolates batching + XLA fusion, not iteration-count
+    tricks. Compile time is reported separately: warmed serving programs
+    take it off the request path entirely.
+    """
+    import jax
+
+    from ncnet_tpu.localize import make_ransac_step
+    from ncnet_tpu.localize.ransac import ransac_pose_np
+
+    out = []
+    n, ratio = 512, 0.3
+    for b, hyp in [(1, 64), (8, 64), (32, 64), (32, 16)]:
+        rays = np.zeros((b, n, 3), np.float32)
+        pts = np.zeros((b, n, 3), np.float32)
+        for j in range(b):
+            r, X = synth_pair(n, ratio, seed=200 + j)
+            rays[j] = r / np.linalg.norm(r, axis=1, keepdims=True)
+            pts[j] = X
+        mask = np.ones((b, n), bool)
+        seeds = np.arange(b, dtype=np.int32)
+
+        step = make_ransac_step(n_hypotheses=hyp, thr_deg=0.2)
+        t0 = time.perf_counter()
+        res = jax.block_until_ready(step(rays, pts, mask, seeds))
+        compile_s = time.perf_counter() - t0
+        t_jax = np.inf
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(step(rays, pts, mask, seeds))
+            t_jax = min(t_jax, time.perf_counter() - t0)
+
+        idx = [
+            np.random.RandomState(300 + j).randint(0, n, size=(hyp, 3))
+            for j in range(b)
+        ]
+        t_np = np.inf
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for j in range(b):
+                ransac_pose_np(
+                    rays[j].astype(np.float64),
+                    pts[j].astype(np.float64),
+                    mask[j], idx[j], thr_rad=THR_RAD,
+                )
+            t_np = min(t_np, time.perf_counter() - t0)
+
+        out.append({
+            "metric": "fixed_schedule_ransac_poses_per_s",
+            "queries": b,
+            "hypotheses": hyp,
+            "tentatives": n,
+            "numpy_sequential": round(b / t_np, 2),
+            "jax_batched": round(b / t_jax, 2),
+            "speedup": round(t_np / t_jax, 1),
+            "jax_compile_s": round(compile_s, 2),
+            "found_inlier_frac": round(
+                float(np.asarray(res["n_inliers"]).mean()) / n, 3
+            ),
+        })
+    return out
+
+
 def bench_densepv():
     from ncnet_tpu.eval.pose_verify import prepare_query, score_prepared
 
@@ -165,6 +234,8 @@ def main():
                     help="also time the round-4 serial hypothesis loop")
     ap.add_argument("--workers", type=int, default=8)
     ap.add_argument("--skip_densepv", action="store_true")
+    ap.add_argument("--skip_jax", action="store_true",
+                    help="skip the batched-XLA vs NumPy-sequential rows")
     args = ap.parse_args()
 
     rows = bench_pnp(serial=args.serial)
@@ -188,6 +259,10 @@ def main():
             N_QUERIES * N_PANOS * worst / args.workers / 60.0, 1
         ),
     }), flush=True)
+
+    if not args.skip_jax:
+        for r in bench_jax_batched():
+            print(json.dumps(r), flush=True)
 
     if not args.skip_densepv:
         for r in bench_densepv():

@@ -102,7 +102,14 @@ def _leaf_bytes(leaf) -> int:
 
 def _aval_bytes(aval) -> int:
     size = int(np.prod(aval.shape, dtype=np.int64)) if aval.shape else 1
-    return size * np.dtype(aval.dtype).itemsize
+    try:
+        itemsize = np.dtype(aval.dtype).itemsize
+    except TypeError:
+        # extended dtypes (the typed PRNG-key avals a threaded
+        # jax.random key introduces, e.g. key<fry>) are not numpy
+        # dtypes but know their physical width
+        itemsize = int(aval.dtype.itemsize)
+    return size * itemsize
 
 
 def trace_program(name: str, built: BuiltProgram) -> TracedProgram:
@@ -664,6 +671,42 @@ def _build_serve_sharded():
     )
 
 
+def _build_localize():
+    import jax
+
+    from ncnet_tpu.localize.request import make_pose_apply
+    from ncnet_tpu.ops.accounting import pose_ransac_flops
+    from ncnet_tpu.serve.engine import SERVE_DONATE_ARGNUMS
+
+    # audit-sized pose geometry: the smallest bucket at a degraded-rung
+    # hypothesis count — every hazard the rules check is shape-blind
+    n_pad, n_hyp, lo_iters = 128, 8, 2
+    fn = jax.jit(
+        make_pose_apply(n_hypotheses=n_hyp, lo_iters=lo_iters),
+        donate_argnums=SERVE_DONATE_ARGNUMS,
+    )
+    rng = np.random.default_rng(0)
+    rays = rng.standard_normal((_BATCH, n_pad, 3)).astype(np.float32)
+    rays[:, :, 2] = np.abs(rays[:, :, 2]) + 1.0  # in front of the camera
+    batch = {
+        "rays": rays,
+        "points": rng.standard_normal((_BATCH, n_pad, 3)).astype(
+            np.float32
+        ),
+        "mask": np.ones((_BATCH, n_pad), bool),
+        "seed": np.arange(_BATCH, dtype=np.int32),
+    }
+    return BuiltProgram(
+        fn=fn,
+        args=({}, batch),
+        donate_expect={
+            argnum: "single-use padded match buffer"
+            for argnum in SERVE_DONATE_ARGNUMS
+        },
+        expected_flops=pose_ransac_flops(_BATCH, n_pad, n_hyp, lo_iters),
+    )
+
+
 def _build_eval_match():
     import jax
 
@@ -724,6 +767,11 @@ PROGRAMS: Dict[str, ProgramSpec] = {
             "eval/match",
             "eval per-pair match fn (the InLoc dump's jitted forward)",
             _build_eval_match,
+        ),
+        ProgramSpec(
+            "localize/ransac",
+            "batched PnP-RANSAC pose program (the pose-bucket apply)",
+            _build_localize,
         ),
     ]
 }

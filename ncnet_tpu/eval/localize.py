@@ -422,6 +422,7 @@ def pnp_localize_pair(
     n_subsample=None,
     max_iters=10000,
     seed=0,
+    solve=True,
 ):
     """Pose of a query camera from dense matches against one RGBD cutout.
 
@@ -438,6 +439,9 @@ def pnp_localize_pair(
       score_thr: reference ``params.ncnet.thr`` = 0.75.
       pnp_thr_deg: reference ``params.ncnet.pnp_thr`` = 0.2 deg.
       n_subsample: optional cap on tentatives (params.ncnet.N_subsample).
+      solve: when False, stop after tentative building (``P`` is None) —
+        lets a batched back-end (ncnet_tpu.localize) consume the
+        tentatives while sharing this exact preprocessing.
 
     Returns:
       dict with ``P`` ([3,4] or None), ``inliers``, ``tentatives_2d``
@@ -488,7 +492,7 @@ def pnp_localize_pair(
         "tentatives_2d": np.stack([xq, yq, xdb, ydb]),
         "tentatives_3d": np.concatenate([rays.T, pts3d.T]),
     }
-    if len(pts3d) < 3:
+    if len(pts3d) < 3 or not solve:
         out["P"], out["inliers"] = None, np.zeros(len(pts3d), bool)
         return out
     P, inl = lo_ransac_p3p(

@@ -1,0 +1,76 @@
+"""Device-native visual localization: batched, jittable PnP-RANSAC.
+
+The seed's `eval/localize.py` is a faithful pure-NumPy port of the
+reference's MATLAB L6 stage — it runs one (query, pano) pair at a time
+on the host while the accelerator idles. This package is the same math
+as a static-shape XLA program:
+
+  * :mod:`ncnet_tpu.localize.solver` — jittable Grunert P3P: quartic
+    roots via the 4x4 companion-matrix eigendecomposition, degenerate /
+    complex solutions MASKED (never branched), a fixed ``[4, 3, 4]``
+    pose slate per minimal sample;
+  * :mod:`ncnet_tpu.localize.ransac` — fixed-iteration LO-RANSAC with
+    static shapes end to end: matches padded/masked to a bucket size,
+    sample indices from a threaded PRNG key, every hypothesis's angular
+    inlier count as one masked reduction, ``vmap`` across hypotheses AND
+    across a batch of queries — no ``while_loop`` on data, no host sync
+    inside the loop;
+  * :mod:`ncnet_tpu.localize.request` — ``PoseRequest``: "image pair ->
+    pose" as a servable request type through `ServeEngine`/`ServeFleet`,
+    with its own bucket family keyed on padded match count and
+    hypothesis-count rungs as the degradation knob.
+
+Exactness contract: the jitted solver matches
+`eval.localize.p3p_grunert` on the same minimal samples, and with the
+same sample sequence the batched RANSAC selects the same best pose as
+the NumPy reference on the synthetic InLoc fixtures — the existing
+module is the oracle the same way ``gemm4`` anchors the sparse band
+(tests/test_localize_jax.py pins both).
+
+Backend note: the quartic eigendecomposition (``jnp.linalg.eigvals`` on
+a nonsymmetric matrix) lowers on the CPU backend; on TPU, run this
+program on the host-attached CPU device or via the CPU proxy (the same
+split the reference makes — L6 never ran on the GPU either). Everything
+else (scoring, Kabsch, DLT) lowers everywhere.
+"""
+
+from ncnet_tpu.localize.ransac import (
+    localize_poses,
+    make_ransac_step,
+    pose_from_matches,
+    ransac_pose,
+    ransac_pose_np,
+    sample_triplets,
+    score_hypotheses,
+)
+from ncnet_tpu.localize.request import (
+    POSE_HYPOTHESIS_RUNGS,
+    POSE_MATCH_BUCKETS,
+    PoseRequest,
+    make_pose_apply,
+    make_pose_engine,
+    pose_bucket,
+    pose_bucket_specs,
+    prep_pose_request,
+)
+from ncnet_tpu.localize.solver import p3p_solve, p3p_solve_batch
+
+__all__ = [
+    "POSE_HYPOTHESIS_RUNGS",
+    "POSE_MATCH_BUCKETS",
+    "PoseRequest",
+    "localize_poses",
+    "make_pose_apply",
+    "make_pose_engine",
+    "make_ransac_step",
+    "p3p_solve",
+    "p3p_solve_batch",
+    "pose_bucket",
+    "pose_bucket_specs",
+    "pose_from_matches",
+    "prep_pose_request",
+    "ransac_pose",
+    "ransac_pose_np",
+    "sample_triplets",
+    "score_hypotheses",
+]

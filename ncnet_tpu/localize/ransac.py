@@ -1,0 +1,400 @@
+"""Fixed-iteration, static-shape LO-RANSAC over the P3P slate.
+
+The oracle (`eval.localize.lo_ransac_p3p`) is an adaptive while-loop:
+draw a chunk, early-exit on the confidence rule, locally optimize the
+incumbent — every decision a host-side branch. The compiled form trades
+the adaptive schedule for a *fixed* hypothesis budget so the whole
+solve is one static-shape program:
+
+  * matches arrive padded to a bucket size with a validity mask — the
+    pad rows carry zero weight through sampling, scoring and the refit,
+    so padding NEVER perturbs the result;
+  * ``sample_triplets`` draws all ``H`` index-triplets up front from a
+    threaded PRNG key, sampling only among valid rows (valid-first
+    stable argsort + uniform draw over ``n_valid``); a duplicate-bearing
+    triplet is masked, not resampled — at the reference's tentative
+    counts the loss is ~3/n of the budget;
+  * every hypothesis slate slot (``4 * H`` poses) is scored in ONE
+    masked reduction (`score_hypotheses` — the oracle's sign-safe
+    ``dot^2 > cos^2 ||Xc||^2`` comparison, batched); invalid slots score
+    -1 so the argmax can never pick them;
+  * local optimization is ``lo_iters`` *unrolled* masked DLT refits
+    (`eval.localize.dlt_pnp` with the inlier subset expressed as 0/1 row
+    weights on the normal matrix, cheirality as a positive-depth
+    majority — the jittable equivalent of the oracle's median test); a
+    refit is accepted only where it does not lose inliers, mirroring the
+    oracle's keep-while-improving rule.
+
+No ``while_loop`` on data, no host sync inside the loop; `vmap` lifts
+the solve across hypotheses (inside `pose_from_matches`) and across a
+batch of queries (`make_ransac_step`). `ransac_pose_np` is the f64
+NumPy reference for the exactness contract, built directly on
+`eval.localize`'s building blocks and consuming the SAME sample-index
+sequence, so fixed-seed tests can demand best-pose agreement rather
+than merely statistical equivalence.
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.localize.solver import _det3, p3p_solve
+from ncnet_tpu.telemetry import trace
+from ncnet_tpu.telemetry.registry import default_registry
+
+#: identity pose — what "no model found" reports instead of None (a
+#: compiled program has no optional return; check ``found``)
+_IDENT_POSE = np.concatenate(
+    [np.eye(3, dtype=np.float32), np.zeros((3, 1), np.float32)], axis=1
+)
+
+
+def unit_rays(rays):
+    """Normalize bearing vectors once, guarded (pad rows are zero)."""
+    norm = jnp.sqrt(jnp.sum(rays * rays, axis=-1, keepdims=True))
+    return rays / jnp.maximum(norm, 1e-12)
+
+
+def sample_triplets(key, mask, n_hypotheses):
+    """Draw ``[H, 3]`` index-triplets among the VALID rows only.
+
+    Valid rows are compacted to the front by a stable argsort on the
+    mask, then each slot draws uniformly over ``n_valid`` — so the
+    distribution over valid rows is independent of how the padding is
+    laid out, and the same ``(key, n_valid)`` yields the same triplets
+    at every bucket size (the pad-invariance contract).
+    """
+    order = jnp.argsort(jnp.where(mask, 0, 1).astype(jnp.int32), stable=True)
+    n_valid = jnp.sum(mask.astype(jnp.int32))
+    hi = jnp.maximum(n_valid, 1)
+    u = jax.random.uniform(key, (n_hypotheses, 3), dtype=jnp.float32)
+    r = jnp.minimum((u * hi.astype(jnp.float32)).astype(jnp.int32), hi - 1)
+    return jnp.take(order, r, axis=0, mode="clip")
+
+
+def score_hypotheses(poses, rays, points, mask, cos_thr):
+    """Masked angular-inlier counts for ``[M, 3, 4]`` poses at once.
+
+    One batched contraction + one reduction — the RANSAC scoring loop
+    with no loop. ``rays`` must be pre-normalized (`unit_rays`). The
+    comparison is the oracle's sign-safe form: ``cos > thr`` iff
+    ``dot > 0 and dot^2 > thr^2 ||Xc||^2`` — no divide, no sqrt.
+    """
+    xc = jnp.einsum("mij,nj->mni", poses[:, :, :3], points)
+    xc = xc + poses[:, None, :, 3]
+    dots = jnp.einsum("mni,ni->mn", xc, rays)
+    sq = jnp.sum(xc * xc, axis=2)
+    inl = (dots > 0.0) & (dots * dots > (cos_thr * cos_thr) * sq)
+    inl = inl & mask[None, :]
+    return jnp.sum(inl.astype(jnp.int32), axis=1)
+
+
+def _inlier_mask(pose, rays, points, mask, cos_thr):
+    """[n] bool angular-inlier mask of one pose (rays pre-normalized)."""
+    xc = points @ pose[:, :3].T + pose[:, 3]
+    dots = jnp.sum(xc * rays, axis=1)
+    sq = jnp.sum(xc * xc, axis=1)
+    return (dots > 0.0) & (dots * dots > (cos_thr * cos_thr) * sq) & mask
+
+
+def _dlt_refit(rays, points, weights):
+    """Masked DLT PnP (`eval.localize.dlt_pnp` with 0/1 row weights).
+
+    The oracle slices the inlier subset; a static-shape program cannot,
+    so the two row families of the 2n x 12 design matrix are weighted
+    and collapsed straight into the 12x12 normal matrix (binary weights:
+    ``w^2 = w``). Returns ``(pose [3, 4], ok)`` where ``ok`` folds the
+    oracle's rejections: < 6 inliers, vanishing scale, cheirality (a
+    positive-depth majority over the inliers — the jittable stand-in
+    for the oracle's ``median > 0``), non-finite output.
+    """
+    n = points.shape[0]
+    xh = jnp.concatenate([points, jnp.ones((n, 1), jnp.float32)], axis=1)
+    zeros = jnp.zeros((n, 4), jnp.float32)
+    a_even = jnp.concatenate(
+        [-rays[:, 2:3] * xh, zeros, rays[:, 0:1] * xh], axis=1
+    )
+    a_odd = jnp.concatenate(
+        [zeros, -rays[:, 2:3] * xh, rays[:, 1:2] * xh], axis=1
+    )
+    w = weights[:, None]
+    ata = a_even.T @ (w * a_even) + a_odd.T @ (w * a_odd)
+    _, evec = jnp.linalg.eigh(ata)
+    p = evec[:, 0].reshape(3, 4)
+    # null-vector sign is arbitrary; resolve BEFORE the SO(3) projection
+    p = jnp.where(_det3(p[:, :3]) < 0.0, -p, p)
+    u, s, vt = jnp.linalg.svd(p[:, :3])
+    r = u @ vt
+    scale = jnp.mean(s)
+    t = p[:, 3] / jnp.maximum(scale, 1e-12)
+    xc = points @ r.T + t
+    dots = jnp.sum(xc * rays, axis=1)
+    n_inl = jnp.sum(weights)
+    n_pos = jnp.sum(jnp.where(dots > 0.0, weights, 0.0))
+    pose = jnp.concatenate([r, t[:, None]], axis=1)
+    ok = (
+        (scale > 1e-10)
+        & (n_inl >= 6.0)
+        & (2.0 * n_pos > n_inl)
+        & jnp.all(jnp.isfinite(pose))
+    )
+    return pose, ok
+
+
+def ransac_pose(rays, points, mask, sample_idx, *, cos_thr, lo_iters=2):
+    """LO-RANSAC best pose from a precomputed sample-index sequence.
+
+    Args:
+      rays: ``[n, 3]`` camera-frame bearings (normalized internally).
+      points: ``[n, 3]`` world points (pad rows: zeros).
+      mask: ``[n]`` bool validity of each row.
+      sample_idx: ``[H, 3]`` int triplet indices (`sample_triplets`).
+      cos_thr: cosine of the angular inlier threshold (static).
+      lo_iters: unrolled local-optimization refits (static).
+
+    Returns:
+      dict of ``P [3, 4]``, ``inliers [n]`` bool, ``n_inliers`` int32,
+      ``found`` bool, ``best_hyp`` int32 (flat slate index). ``P`` is
+      the identity pose when ``found`` is False.
+    """
+    rays = unit_rays(jnp.asarray(rays, jnp.float32))
+    points = jnp.asarray(points, jnp.float32)
+    h = sample_idx.shape[0]
+
+    tri_f = jnp.take(rays, sample_idx, axis=0, mode="clip")  # [H, 3, 3]
+    tri_x = jnp.take(points, sample_idx, axis=0, mode="clip")
+    poses, valid = jax.vmap(p3p_solve)(tri_f, tri_x)  # [H,4,3,4], [H,4]
+
+    dup = (
+        (sample_idx[:, 0] == sample_idx[:, 1])
+        | (sample_idx[:, 0] == sample_idx[:, 2])
+        | (sample_idx[:, 1] == sample_idx[:, 2])
+    )
+    n_valid = jnp.sum(mask.astype(jnp.int32))
+    valid = valid & (~dup)[:, None] & (n_valid >= 3)
+
+    flat_p = poses.reshape(h * 4, 3, 4)
+    flat_ok = valid.reshape(h * 4)
+    counts = score_hypotheses(flat_p, rays, points, mask, cos_thr)
+    counts = jnp.where(flat_ok, counts, -1)
+    best = jnp.argmax(counts).astype(jnp.int32)
+    best_pose = jnp.take(flat_p, best[None], axis=0, mode="clip")[0]
+    best_count = jnp.take(counts, best[None], axis=0, mode="clip")[0]
+    found = best_count > 0
+
+    for _ in range(lo_iters):
+        inl = _inlier_mask(best_pose, rays, points, mask, cos_thr)
+        pose_lo, ok = _dlt_refit(rays, points, inl.astype(jnp.float32))
+        cnt_lo = score_hypotheses(
+            pose_lo[None], rays, points, mask, cos_thr
+        )[0]
+        accept = ok & found & (cnt_lo >= best_count)
+        best_pose = jnp.where(accept, pose_lo, best_pose)
+        best_count = jnp.where(accept, cnt_lo, best_count)
+
+    best_pose = jnp.where(found, best_pose, jnp.asarray(_IDENT_POSE))
+    inliers = _inlier_mask(best_pose, rays, points, mask, cos_thr) & found
+    return {
+        "P": best_pose,
+        "inliers": inliers,
+        "n_inliers": jnp.maximum(best_count, 0).astype(jnp.int32),
+        "found": found,
+        "best_hyp": best,
+    }
+
+
+def pose_from_matches(
+    rays, points, mask, seed, *, n_hypotheses, cos_thr, lo_iters=2
+):
+    """One query's full solve: threaded PRNG sampling + `ransac_pose`.
+
+    ``seed`` is a traced int32, so the whole thing jits and vmaps with
+    per-query seeds (the serve path batches exactly this function).
+    """
+    key = jax.random.PRNGKey(seed)
+    idx = sample_triplets(key, mask, n_hypotheses)
+    return ransac_pose(
+        rays, points, mask, idx, cos_thr=cos_thr, lo_iters=lo_iters
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def make_ransac_step(n_hypotheses=64, thr_deg=0.2, lo_iters=2):
+    """Jitted batched solver ``step(rays, points, mask, seeds)``.
+
+    ``[b, n, 3] x 2 + [b, n] + [b] -> dict of [b, ...]`` — `vmap` across
+    queries of `pose_from_matches`. Memoized so repeated calls at one
+    geometry share a single jit wrapper (the `recompile-hazard`
+    discipline, same shape as ``make_train_step``).
+    """
+    cos_thr = float(np.cos(np.deg2rad(thr_deg)))
+    fn = functools.partial(
+        pose_from_matches,
+        n_hypotheses=n_hypotheses,
+        cos_thr=cos_thr,
+        lo_iters=lo_iters,
+    )
+    return jax.jit(jax.vmap(fn))
+
+
+# ------------------------------------------------------- staged host driver
+
+
+@functools.lru_cache(maxsize=None)
+def _sample_stage(n_hypotheses):
+    def stage(seeds, mask):
+        keys = jax.vmap(jax.random.PRNGKey)(seeds)
+        return jax.vmap(
+            functools.partial(sample_triplets, n_hypotheses=n_hypotheses)
+        )(keys, mask)
+
+    return jax.jit(stage)
+
+
+@functools.lru_cache(maxsize=None)
+def _solve_stage():
+    def stage(rays, points, idx):
+        rays = unit_rays(jnp.asarray(rays, jnp.float32))
+        points = jnp.asarray(points, jnp.float32)
+        # per-query gather: vmap keeps the [H, 3] indices local to a row
+        tri_f = jax.vmap(
+            lambda r, i: jnp.take(r, i, axis=0, mode="clip")
+        )(rays, idx)
+        tri_x = jax.vmap(
+            lambda p, i: jnp.take(p, i, axis=0, mode="clip")
+        )(points, idx)
+        return jax.vmap(jax.vmap(p3p_solve))(tri_f, tri_x)
+
+    return jax.jit(stage)
+
+
+@functools.lru_cache(maxsize=None)
+def _score_stage(cos_thr, lo_iters):
+    def one(rays, points, mask, idx):
+        return ransac_pose(
+            rays, points, mask, idx, cos_thr=cos_thr, lo_iters=lo_iters
+        )
+
+    return jax.jit(jax.vmap(one))
+
+
+def localize_poses(
+    rays, points, mask, seeds, *, n_hypotheses=64, thr_deg=0.2, lo_iters=2
+):
+    """Host driver with per-stage telemetry spans.
+
+    Runs the batched solve as three jitted stages — ``localize/sample``
+    (index generation), ``localize/solve`` (the P3P slates, traced for
+    span attribution only; the fused score stage re-derives them so its
+    program stays self-contained), ``localize/score`` (scoring + LO) —
+    and bumps ``localize_poses_total``. The serve path compiles the SAME
+    math as one fused program (`localize.request.make_pose_apply`);
+    this staged variant exists for the CLI and benchmarks, where stage
+    timing is the thing being measured.
+    """
+    m_poses = default_registry().counter(
+        "localize_poses_total",
+        "camera poses estimated by the batched JAX localizer",
+    )
+    seeds = jnp.asarray(seeds, jnp.int32)
+    mask = jnp.asarray(mask, bool)
+    with trace.span("localize/sample"):
+        idx = _sample_stage(n_hypotheses)(seeds, mask)
+        jax.block_until_ready(idx)
+    with trace.span("localize/solve"):
+        slates = _solve_stage()(rays, points, idx)
+        jax.block_until_ready(slates)
+    cos_thr = float(np.cos(np.deg2rad(thr_deg)))
+    with trace.span("localize/score"):
+        out = _score_stage(cos_thr, lo_iters)(rays, points, mask, idx)
+        jax.block_until_ready(out)
+    m_poses.inc(int(seeds.shape[0]))
+    return out
+
+
+# ------------------------------------------------------- NumPy reference
+
+
+def ransac_pose_np(rays, points, mask, sample_idx, *, thr_rad, lo_iters=2):
+    """f64 NumPy reference of `ransac_pose`, built on the oracle.
+
+    Consumes the SAME ``[H, 3]`` sample-index sequence as the jitted
+    path and mirrors its fixed schedule (score-all-then-argmax,
+    ``lo_iters`` accept-if-no-worse refits), but every building block is
+    `eval.localize`'s own: `_p3p_grunert_batch`, `_count_inliers_batch`,
+    `_angular_inliers`, `dlt_pnp`. This is the exactness-contract
+    anchor: with a fixed seed the batched program must select the same
+    best pose this reference does (tests/test_localize_jax.py).
+
+    Returns the same dict shape as `ransac_pose` (numpy arrays; ``P``
+    is the identity pose when not found).
+    """
+    from ncnet_tpu.eval import localize as oracle
+
+    rays = np.asarray(rays, np.float64)
+    points = np.asarray(points, np.float64)
+    mask = np.asarray(mask, bool)
+    sel = np.asarray(sample_idx, int)
+    n = len(points)
+    cos_thr = float(np.cos(thr_rad))
+    unit = rays / np.maximum(
+        np.linalg.norm(rays, axis=1, keepdims=True), 1e-12
+    )
+
+    out = {
+        "P": _IDENT_POSE.astype(np.float64),
+        "inliers": np.zeros(n, bool),
+        "n_inliers": 0,
+        "found": False,
+    }
+    if int(mask.sum()) < 3:
+        return out
+
+    dup = (
+        (sel[:, 0] == sel[:, 1])
+        | (sel[:, 0] == sel[:, 2])
+        | (sel[:, 1] == sel[:, 2])
+    )
+    keep = ~dup
+    if not keep.any():
+        return out
+    cand_p, owner = oracle._p3p_grunert_batch(
+        unit[sel[keep]], points[sel[keep]]
+    )
+    if len(cand_p) == 0:
+        return out
+    counts = oracle._count_inliers_batch(
+        cand_p, unit[mask], points[mask], cos_thr
+    )
+    best = int(np.argmax(counts))
+    best_pose = cand_p[best]
+    best_count = int(counts[best])
+    if best_count <= 0:
+        return out
+
+    for _ in range(lo_iters):
+        inl = oracle._angular_inliers(best_pose, unit, points, cos_thr)
+        inl = inl & mask
+        if inl.sum() < 6:
+            continue
+        pose_lo = oracle.dlt_pnp(unit[inl], points[inl])
+        if pose_lo is None:
+            continue
+        cnt_lo = int(
+            oracle._count_inliers_batch(
+                pose_lo[None], unit[mask], points[mask], cos_thr
+            )[0]
+        )
+        if cnt_lo >= best_count:
+            best_pose, best_count = pose_lo, cnt_lo
+
+    inliers = (
+        oracle._angular_inliers(best_pose, unit, points, cos_thr) & mask
+    )
+    out.update(
+        P=best_pose, inliers=inliers, n_inliers=best_count, found=True
+    )
+    return out

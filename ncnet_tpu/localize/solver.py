@@ -1,0 +1,315 @@
+"""Jittable Grunert P3P: a fixed ``[4, 3, 4]`` pose slate per sample.
+
+The math is `eval.localize.p3p_grunert` (Grunert 1841 via the Haralick
+et al. survey), restated for XLA: the NumPy oracle returns a *list* of
+0-4 poses and branches on every degeneracy; a compiled program cannot.
+Instead every minimal sample always produces the full 4-slot slate plus
+a validity mask —
+
+  * the quartic in ``v = s3/s1`` is solved as the eigenvalues of its
+    4x4 monic companion matrix (``np.roots`` is exactly this for one
+    polynomial), so all four candidate roots exist as array slots;
+  * every oracle early-return (short triangle side, vanishing leading
+    coefficient, complex root, negative ``v``/``u``/``s1^2``, singular
+    denominator, non-finite fit) becomes a mask bit, and the guarded
+    denominators are substituted with 1 so the masked lanes still
+    compute finite garbage instead of NaN-poisoning the batch;
+  * invalid slots are overwritten with the identity pose, so downstream
+    scoring reads a well-formed ``[4, 3, 4]`` array unconditionally and
+    the RANSAC argmax simply never selects a masked slot (its inlier
+    count is forced to -1).
+
+float32 end to end (the jaxpr audit's f64-leak rule is an error
+repo-wide): companion eigenvalues in f32 carry ~1e-4 relative error, so
+real roots get two Newton polish steps on the quartic before the
+back-substitution — that is what buys the tight-parity contract against
+the f64 oracle (tests/test_localize_jax.py). The degeneracy cutoffs are
+correspondingly wider than the oracle's f64 ones; they are calibrated
+so that on *non-degenerate* samples both sides agree on validity and on
+clearly-degenerate ones both mask.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+#: f32-calibrated degeneracy guards (oracle f64 counterparts in parens):
+#: minimum triangle side (1e-12), minimum |denominator| in the u / s1^2
+#: back-substitution (1e-12), minimum |A4| for a genuine quartic (1e-14),
+#: and the relative imaginary tolerance for calling a companion
+#: eigenvalue real (1e-8 absolute — f32 eig needs the relative form).
+_SIDE_EPS = 1e-6
+_DENOM_EPS = 1e-6
+_LEAD_EPS = 1e-10
+_IMAG_TOL = 1e-3
+_NEWTON_STEPS = 2
+
+
+def _det3(m):
+    """Closed-form 3x3 determinant over a leading batch.
+
+    Elementwise on purpose: ``jnp.linalg.det`` lowers through an LU
+    custom call, which would add a non-contraction kernel to a program
+    whose flop ledger (`ops.accounting.pose_ransac_flops`) counts pure
+    dot_generals.
+    """
+    return (
+        m[..., 0, 0] * (m[..., 1, 1] * m[..., 2, 2] - m[..., 1, 2] * m[..., 2, 1])
+        - m[..., 0, 1] * (m[..., 1, 0] * m[..., 2, 2] - m[..., 1, 2] * m[..., 2, 0])
+        + m[..., 0, 2] * (m[..., 1, 0] * m[..., 2, 1] - m[..., 1, 1] * m[..., 2, 0])
+    )
+
+
+def kabsch(world_pts, cam_pts):
+    """Batched Kabsch rigid fit ``x_cam = R x_world + t`` (no scale).
+
+    Args:
+      world_pts: ``[s, 3, 3]`` world-point triplets (rows).
+      cam_pts: ``[s, 3, 3]`` camera-frame triplets.
+
+    Returns:
+      ``[s, 3, 4]`` poses ``P = [R | t]`` — `_absolute_orientation`
+      batched, with the reflection fix applied per slot.
+    """
+    cw = jnp.mean(world_pts, axis=1, keepdims=True)
+    cc = jnp.mean(cam_pts, axis=1, keepdims=True)
+    h = jnp.einsum("ski,skj->sij", world_pts - cw, cam_pts - cc)
+    u, _, vt = jnp.linalg.svd(h)
+    d = jnp.sign(_det3(jnp.einsum("sji,skj->sik", vt, u)))
+    d = jnp.where(d == 0.0, 1.0, d)
+    flip = jnp.concatenate(
+        [jnp.ones_like(d)[:, None], jnp.ones_like(d)[:, None], d[:, None]],
+        axis=1,
+    )
+    r = jnp.einsum("sji,skj->sik", vt * flip[:, :, None], u)
+    t = cc[:, 0] - jnp.einsum("sij,sj->si", r, cw[:, 0])
+    return jnp.concatenate([r, t[:, :, None]], axis=2)
+
+
+def p3p_solve(rays, points):
+    """Absolute pose slate from 3 ray/point correspondences.
+
+    Args:
+      rays: ``[3, 3]`` bearing vectors in the camera frame (rows; need
+        not be normalized).
+      points: ``[3, 3]`` corresponding world points (rows).
+
+    Returns:
+      ``(poses, valid)`` — ``poses`` is the fixed ``[4, 3, 4]`` slate of
+      ``P = [R | t]`` candidates (``x_cam = R x_world + t``), ``valid``
+      the ``[4]`` bool mask of admissible slots. Invalid slots hold the
+      identity pose. Matches `eval.localize.p3p_grunert` on the valid
+      slots (slate order follows the companion eigenvalue order, which
+      differs from ``np.roots`` — compare as sets).
+    """
+    rays = jnp.asarray(rays, jnp.float32)
+    points = jnp.asarray(points, jnp.float32)
+    norm = jnp.sqrt(jnp.sum(rays * rays, axis=1, keepdims=True))
+    f = rays / jnp.maximum(norm, 1e-12)
+
+    d23 = points[1] - points[2]
+    d13 = points[0] - points[2]
+    d12 = points[0] - points[1]
+    a2 = jnp.sum(d23 * d23)  # side opposite point 1, squared
+    b2 = jnp.sum(d13 * d13)
+    c2 = jnp.sum(d12 * d12)
+    side_ok = jnp.minimum(jnp.minimum(a2, b2), c2) > _SIDE_EPS * _SIDE_EPS
+    b2s = jnp.where(side_ok, b2, 1.0)
+
+    cos_a = jnp.sum(f[1] * f[2])
+    cos_b = jnp.sum(f[0] * f[2])
+    cos_g = jnp.sum(f[0] * f[1])
+
+    # Grunert's quartic in v = s3/s1 — the oracle's coefficients verbatim
+    q = (a2 - c2) / b2s
+    a4 = (q - 1.0) ** 2 - 4.0 * (c2 / b2s) * cos_a**2
+    a3 = 4.0 * (
+        q * (1.0 - q) * cos_b
+        - (1.0 - (a2 + c2) / b2s) * cos_a * cos_g
+        + 2.0 * (c2 / b2s) * cos_a**2 * cos_b
+    )
+    a2_ = 2.0 * (
+        q**2
+        - 1.0
+        + 2.0 * q**2 * cos_b**2
+        + 2.0 * ((b2 - c2) / b2s) * cos_a**2
+        - 4.0 * ((a2 + c2) / b2s) * cos_a * cos_b * cos_g
+        + 2.0 * ((b2 - a2) / b2s) * cos_g**2
+    )
+    a1 = 4.0 * (
+        -q * (1.0 + q) * cos_b
+        + 2.0 * (a2 / b2s) * cos_g**2 * cos_b
+        - (1.0 - (a2 + c2) / b2s) * cos_a * cos_g
+    )
+    a0 = (1.0 + q) ** 2 - 4.0 * (a2 / b2s) * cos_g**2
+
+    coeffs = jnp.stack([a4, a3, a2_, a1, a0])
+    lead_ok = jnp.abs(a4) > _LEAD_EPS
+    coeffs_ok = side_ok & lead_ok & jnp.all(jnp.isfinite(coeffs))
+
+    # batched np.roots: the monic companion matrix, eigenvalues = roots
+    mono = coeffs[1:] / jnp.where(lead_ok, a4, 1.0)
+    mono_ok = jnp.all(jnp.isfinite(mono))
+    mono = jnp.where(mono_ok, mono, jnp.zeros_like(mono))
+    comp = jnp.zeros((4, 4), jnp.float32)
+    comp = comp.at[1, 0].set(1.0).at[2, 1].set(1.0).at[3, 2].set(1.0)
+    comp = comp.at[0, :].set(-mono)
+    roots = jnp.linalg.eigvals(comp)  # [4] complex64; CPU lowering
+    v = jnp.real(roots)
+    imag_ok = jnp.abs(jnp.imag(roots)) <= _IMAG_TOL * (1.0 + jnp.abs(v))
+
+    # Newton polish: pull f32 eigenvalues onto the quartic's real roots
+    for _ in range(_NEWTON_STEPS):
+        pv = (((a4 * v + a3) * v + a2_) * v + a1) * v + a0
+        dpv = ((4.0 * a4 * v + 3.0 * a3) * v + 2.0 * a2_) * v + a1
+        dp_ok = jnp.abs(dpv) > _DENOM_EPS
+        v = jnp.where(dp_ok, v - pv / jnp.where(dp_ok, dpv, 1.0), v)
+
+    denom = 2.0 * (cos_g - v * cos_a)
+    denom_ok = jnp.abs(denom) > _DENOM_EPS
+    u = ((q - 1.0) * v * v - 2.0 * q * cos_b * v + 1.0 + q) / jnp.where(
+        denom_ok, denom, 1.0
+    )
+    s1_den = 1.0 + v * v - 2.0 * v * cos_b
+    s1_den_ok = s1_den > _DENOM_EPS
+    s1sq = b2 / jnp.where(s1_den_ok, s1_den, 1.0)
+    valid = (
+        coeffs_ok
+        & mono_ok
+        & imag_ok
+        & (v > 0.0)
+        & denom_ok
+        & (u > 0.0)
+        & s1_den_ok
+        & jnp.isfinite(u)
+        & jnp.isfinite(s1sq)
+    )
+
+    s1 = jnp.sqrt(jnp.maximum(s1sq, 0.0))
+    scales = jnp.stack([s1, u * s1, v * s1], axis=1)  # [4, 3]
+    cam = scales[:, :, None] * f[None, :, :]  # [4, 3, 3]
+    poses = kabsch(jnp.broadcast_to(points[None], (4, 3, 3)), cam)
+    valid = valid & jnp.all(jnp.isfinite(poses.reshape(4, 12)), axis=1)
+
+    ident = jnp.concatenate(
+        [jnp.eye(3, dtype=jnp.float32), jnp.zeros((3, 1), jnp.float32)],
+        axis=1,
+    )
+    poses = jnp.where(valid[:, None, None], poses, ident[None])
+    return poses, valid
+
+
+def p3p_solve_batch(rays, points):
+    """`p3p_solve` vmapped over a leading sample axis.
+
+    ``[s, 3, 3] x 2 -> ([s, 4, 3, 4], [s, 4])``.
+    """
+    return jax.vmap(p3p_solve)(rays, points)
+
+
+def p3p_slate_np(rays, points):
+    """f64 NumPy mirror of `p3p_solve` for the exactness contract.
+
+    Identical control structure (companion eigenvalues, slate slots,
+    mask bits, Newton polish) evaluated at double precision — the bridge
+    between the list-shaped oracle `eval.localize.p3p_grunert` and the
+    slate-shaped jitted solver: tests check oracle poses appear among
+    this mirror's valid slots AND that the jitted slots match the
+    mirror's slot-for-slot.
+
+    Returns ``(poses [4, 3, 4], valid [4])`` numpy arrays.
+    """
+    rays = np.asarray(rays, np.float64)
+    points = np.asarray(points, np.float64)
+    f = rays / np.maximum(
+        np.linalg.norm(rays, axis=1, keepdims=True), 1e-12
+    )
+    a2 = float(np.sum((points[1] - points[2]) ** 2))
+    b2 = float(np.sum((points[0] - points[2]) ** 2))
+    c2 = float(np.sum((points[0] - points[1]) ** 2))
+    side_ok = min(a2, b2, c2) > _SIDE_EPS * _SIDE_EPS
+    b2s = b2 if side_ok else 1.0
+    cos_a = float(f[1] @ f[2])
+    cos_b = float(f[0] @ f[2])
+    cos_g = float(f[0] @ f[1])
+    q = (a2 - c2) / b2s
+    a4 = (q - 1.0) ** 2 - 4.0 * (c2 / b2s) * cos_a**2
+    a3 = 4.0 * (
+        q * (1.0 - q) * cos_b
+        - (1.0 - (a2 + c2) / b2s) * cos_a * cos_g
+        + 2.0 * (c2 / b2s) * cos_a**2 * cos_b
+    )
+    a2_ = 2.0 * (
+        q**2
+        - 1.0
+        + 2.0 * q**2 * cos_b**2
+        + 2.0 * ((b2 - c2) / b2s) * cos_a**2
+        - 4.0 * ((a2 + c2) / b2s) * cos_a * cos_b * cos_g
+        + 2.0 * ((b2 - a2) / b2s) * cos_g**2
+    )
+    a1 = 4.0 * (
+        -q * (1.0 + q) * cos_b
+        + 2.0 * (a2 / b2s) * cos_g**2 * cos_b
+        - (1.0 - (a2 + c2) / b2s) * cos_a * cos_g
+    )
+    a0 = (1.0 + q) ** 2 - 4.0 * (a2 / b2s) * cos_g**2
+    coeffs = np.array([a4, a3, a2_, a1, a0])
+    lead_ok = abs(a4) > _LEAD_EPS
+    coeffs_ok = side_ok and lead_ok and bool(np.all(np.isfinite(coeffs)))
+    mono = coeffs[1:] / (a4 if lead_ok else 1.0)
+    mono_ok = bool(np.all(np.isfinite(mono)))
+    comp = np.zeros((4, 4))
+    comp[1, 0] = comp[2, 1] = comp[3, 2] = 1.0
+    comp[0, :] = -mono if mono_ok else 0.0
+    roots = np.linalg.eigvals(comp)
+    v = roots.real.copy()
+    imag_ok = np.abs(roots.imag) <= _IMAG_TOL * (1.0 + np.abs(v))
+    for _ in range(_NEWTON_STEPS):
+        pv = (((a4 * v + a3) * v + a2_) * v + a1) * v + a0
+        dpv = ((4.0 * a4 * v + 3.0 * a3) * v + 2.0 * a2_) * v + a1
+        dp_ok = np.abs(dpv) > _DENOM_EPS
+        v = np.where(dp_ok, v - pv / np.where(dp_ok, dpv, 1.0), v)
+    denom = 2.0 * (cos_g - v * cos_a)
+    denom_ok = np.abs(denom) > _DENOM_EPS
+    with np.errstate(divide="ignore", invalid="ignore"):
+        u = ((q - 1.0) * v * v - 2.0 * q * cos_b * v + 1.0 + q) / np.where(
+            denom_ok, denom, 1.0
+        )
+        s1_den = 1.0 + v * v - 2.0 * v * cos_b
+        s1_den_ok = s1_den > _DENOM_EPS
+        s1sq = b2 / np.where(s1_den_ok, s1_den, 1.0)
+    valid = (
+        coeffs_ok
+        & mono_ok
+        & imag_ok
+        & (v > 0.0)
+        & denom_ok
+        & (u > 0.0)
+        & s1_den_ok
+        & np.isfinite(u)
+        & np.isfinite(s1sq)
+    )
+    s1 = np.sqrt(np.maximum(s1sq, 0.0))
+    scales = np.stack([s1, u * s1, v * s1], axis=1)
+    cam = scales[:, :, None] * f[None, :, :]
+    cw = points.mean(axis=0)
+    poses = np.zeros((4, 3, 4))
+    poses[:, :, :3] = np.eye(3)
+    for i in range(4):
+        if not valid[i]:
+            continue
+        cc = cam[i].mean(axis=0)
+        h = (points - cw).T @ (cam[i] - cc)
+        uu, _, vt = np.linalg.svd(h)
+        d = np.sign(np.linalg.det(vt.T @ uu.T))
+        r = vt.T @ np.diag([1.0, 1.0, d if d != 0 else 1.0]) @ uu.T
+        t = cc - r @ cw
+        p = np.concatenate([r, t[:, None]], axis=1)
+        if np.all(np.isfinite(p)):
+            poses[i] = p
+        else:
+            valid[i] = False
+            poses[i, :, :3] = np.eye(3)
+            poses[i, :, 3] = 0.0
+    return poses, valid

@@ -150,3 +150,37 @@ def train_step_flops_for_batch(config, batch, from_features=False,
         cnn=cnn,
         trunk_trainable=trunk_trainable,
     )
+
+
+def pose_ransac_flops(batch, n_pad, n_hypotheses, lo_iters=2):
+    """Contraction FLOPs (2*MACs) of the ``localize/ransac`` program.
+
+    Counts the dot_generals of one batched LO-RANSAC solve
+    (`localize.ransac.pose_from_matches` vmapped over ``batch``
+    queries), matching `analysis.jaxpr_audit.jaxpr_flops`' convention:
+    elementwise/reduction work and the eig/svd/eigh LAPACK custom calls
+    are excluded on both sides, so the walk-vs-form cross-check compares
+    like with like. Per query, with ``H`` hypotheses, ``n = n_pad``
+    padded matches and ``L`` LO refits:
+
+      * Kabsch rigid fits over the 4-slot slates: the cross-covariance,
+        reflection-sign and rotation einsums (3 x ``2*4*3*3*3``) plus
+        the translation (``2*4*3*3``) -> ``720 H``;
+      * hypothesis scoring as one masked reduction over ``M = 4H``
+        poses: point rotation ``2*M*n*3*3`` + ray dots ``2*M*n*3``
+        -> ``96 H n``;
+      * each LO refit: inlier re-mask (``18 n``), the two weighted
+        12x12 normal-matrix products (``2 * 2*144*n``), the 3x3 SO(3)
+        projection product (54), cheirality re-projection (``18 n``)
+        and the acceptance re-score (``24 n``) -> ``636 n + 54``
+        per iteration;
+      * the final inlier mask: ``18 n``.
+    """
+    h, n, li = float(n_hypotheses), float(n_pad), float(lo_iters)
+    per_query = (
+        720.0 * h
+        + 96.0 * h * n
+        + li * (636.0 * n + 54.0)
+        + 18.0 * n
+    )
+    return float(batch) * per_query

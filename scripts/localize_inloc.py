@@ -48,6 +48,54 @@ def parse_cutout_name(pano_fn):
     return floor, parts[0], parts[2]
 
 
+def _solve_shortlist_jax(tentatives, task):
+    """Batched device solve of ONE query's whole shortlist: every pano's
+    tentative set padded to a common pose bucket, one `localize_poses`
+    call across the shortlist (batch axis = panos). The per-pair NumPy
+    LO-RANSAC becomes a single static-shape XLA program invocation."""
+    import numpy as np
+
+    from ncnet_tpu.localize import (
+        PoseRequest,
+        localize_poses,
+        prep_pose_request,
+    )
+
+    preps = [
+        prep_pose_request(
+            PoseRequest.from_tentatives(t, seed=task["seed"])
+        )
+        for t in tentatives
+    ]
+    n_pad = max(key[1] for key, _ in preps)
+
+    def pad_to(a, fill):
+        short = n_pad - a.shape[0]
+        if short == 0:
+            return a
+        return np.concatenate(
+            [a, np.full((short,) + a.shape[1:], fill, a.dtype)], axis=0
+        )
+
+    batch = {
+        name: np.stack([pad_to(p[name], 0) for _, p in preps])
+        for name in ("rays", "points", "mask")
+    }
+    out = localize_poses(
+        batch["rays"],
+        batch["points"],
+        batch["mask"],
+        np.stack([p["seed"] for _, p in preps]),
+        n_hypotheses=task["n_hypotheses"],
+        thr_deg=task["pnp_thr_deg"],
+    )
+    found = np.asarray(out["found"])
+    poses = np.asarray(out["P"], np.float64)
+    return [
+        poses[j].tolist() if found[j] else None for j in range(len(preps))
+    ]
+
+
 def _localize_query(task):
     """One query's PnP stage (worker-safe: module-level + picklable args;
     the reference runs exactly this loop under MATLAB parfor,
@@ -57,12 +105,14 @@ def _localize_query(task):
     from ncnet_tpu.eval.localize import pnp_localize_pair
 
     q = task["q"]
+    use_jax = task["backend"] == "jax"
     matches = loadmat(task["match_path"])["matches"]  # [1, Npanos, N, 5]
     from PIL import Image
 
     with Image.open(task["query_img"]) as im:
         qw, qh = im.size
     entry = {"queryname": task["query_fn"], "topNname": [], "P": []}
+    tentatives = []
     for idx, pano_fn in enumerate(task["pano_fns"][: matches.shape[1]]):
         cutout = load_cutout(
             os.path.join(task["cutout_dir"], pano_fn + ".mat")
@@ -85,9 +135,18 @@ def _localize_query(task):
             alignment=align,
             score_thr=task["score_thr"],
             pnp_thr_deg=task["pnp_thr_deg"],
+            seed=task["seed"],
+            solve=not use_jax,
         )
         entry["topNname"].append(pano_fn)
-        entry["P"].append(None if out["P"] is None else out["P"].tolist())
+        if use_jax:
+            tentatives.append(out["tentatives_3d"])
+        else:
+            entry["P"].append(
+                None if out["P"] is None else out["P"].tolist()
+            )
+    if use_jax:
+        entry["P"] = _solve_shortlist_jax(tentatives, task)
     return q, entry
 
 
@@ -133,6 +192,17 @@ def main():
     p.add_argument("--n_panos", type=int, default=10)
     p.add_argument("--score_thr", type=float, default=0.75)
     p.add_argument("--pnp_thr_deg", type=float, default=0.2)
+    p.add_argument("--backend", choices=("numpy", "jax"), default="numpy",
+                   help="PnP back-end: 'numpy' = per-pair host LO-RANSAC "
+                        "(eval.localize, adaptive iteration count); "
+                        "'jax' = the batched fixed-hypothesis XLA program "
+                        "(ncnet_tpu.localize) — one solve per query "
+                        "across its whole shortlist")
+    p.add_argument("--n_hypotheses", type=int, default=64,
+                   help="--backend jax: static RANSAC hypothesis count "
+                        "per pair (the serving path's primary rung)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="RANSAC sample seed (both back-ends)")
     p.add_argument("--refposes", default="",
                    help=".mat with DUC1_RefList/DUC2_RefList GT poses; "
                         "prints the localization curve when given")
@@ -180,6 +250,9 @@ def main():
             "focal": args.focal,
             "score_thr": args.score_thr,
             "pnp_thr_deg": args.pnp_thr_deg,
+            "backend": args.backend,
+            "n_hypotheses": args.n_hypotheses,
+            "seed": args.seed,
         })
 
     results = []

@@ -1,0 +1,165 @@
+"""`PoseRequest` through the serve engine (PR 13): warmup compiles the
+pose bucket family in both hypothesis rungs, the hysteresis controller
+degrades ``n_hypotheses`` exactly like it degrades ``nc_topk`` — the
+served result is BITWISE the degraded program's own output, with ZERO
+recompiles across the flip — and a ``serve.request`` fault through the
+pose prep path fails typed while the accounting ledger stays exact."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from ncnet_tpu.localize import (
+    PoseRequest,
+    make_pose_apply,
+    make_pose_engine,
+    pose_bucket_specs,
+    prep_pose_request,
+)
+from ncnet_tpu.resilience import faultinject
+from ncnet_tpu.serve import HysteresisController, StageFailure
+
+PRIMARY, DEGRADED = 16, 8  # small rungs: two cheap warmup traces
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.clear()
+    yield
+    faultinject.clear()
+
+
+def _forced_controller():
+    # every pressure reading (>= 0) is "overload": flips on the dispatch
+    # loop's first observation (the test_serve_resilience idiom)
+    return HysteresisController(high=0.0, low=-1.0, up_count=1)
+
+
+def _pose_request(seed=3, n=100, inlier_ratio=0.7):
+    rng = np.random.RandomState(seed)
+    q, _ = np.linalg.qr(rng.randn(3, 3))
+    if np.linalg.det(q) < 0:
+        q[:, 0] *= -1
+    t = rng.randn(3)
+    x = rng.randn(n, 3) * 4.0 + np.array([0, 0, 8.0])
+    xc = x @ q.T + t
+    rays = xc / np.linalg.norm(xc, axis=1, keepdims=True)
+    n_out = int(n * (1.0 - inlier_ratio))
+    out_idx = rng.permutation(n)[:n_out]
+    rand = rng.randn(n_out, 3)
+    rays[out_idx] = rand / np.linalg.norm(rand, axis=1, keepdims=True)
+    return PoseRequest(
+        rays.astype(np.float32), x.astype(np.float32), seed=seed
+    )
+
+
+def _invariant(stats):
+    assert stats["submitted"] == (
+        stats["completed"] + stats["failed"] + stats["shed"]
+        + stats["deadline_exceeded"]
+    )
+
+
+def test_pose_degradation_flip_zero_recompiles():
+    """Under forced overload the engine serves the DEGRADED-rung pose
+    program, bitwise that program's own output, without compiling
+    anything after warmup — the hypothesis count degrades exactly like
+    nc_topk does on the match path."""
+    req = _pose_request()
+    _, payload = prep_pose_request(req)
+    batch = {k: np.asarray(v)[None] for k, v in payload.items()}
+    expected = jax.jit(make_pose_apply(DEGRADED))({}, batch)
+
+    with make_pose_engine(
+        n_hypotheses=PRIMARY, degraded_hypotheses=DEGRADED,
+        max_batch=1, degrade_controller=_forced_controller(),
+    ) as eng:
+        eng.warmup(pose_bucket_specs((128,)))
+        warm = eng.compile_count
+        assert warm == 2  # both rungs pre-warmed at bs 1
+        got = eng.submit(req).result(timeout=60)
+        assert eng.compile_count == warm  # the flip compiled NOTHING
+        stats = eng.report()
+    assert bool(got["found"])
+    for k in ("P", "inliers", "n_inliers", "found", "best_hyp"):
+        np.testing.assert_array_equal(
+            np.asarray(got[k]), np.asarray(expected[k])[0]
+        )
+    assert stats["degraded_mode"] is True
+    assert stats["degraded_batches"] == 1
+    assert stats["degrade_flips"] >= 1
+    assert stats["recompiles_after_warmup"] == 0
+    _invariant(stats)
+
+
+def test_pose_primary_rung_without_pressure():
+    req = _pose_request(seed=4)
+    _, payload = prep_pose_request(req)
+    batch = {k: np.asarray(v)[None] for k, v in payload.items()}
+    expected = jax.jit(make_pose_apply(PRIMARY))({}, batch)
+
+    with make_pose_engine(
+        n_hypotheses=PRIMARY, degraded_hypotheses=DEGRADED, max_batch=1,
+    ) as eng:  # default controller: idle traffic never reaches high water
+        eng.warmup(pose_bucket_specs((128,)))
+        got = eng.submit(req).result(timeout=60)
+        stats = eng.report()
+    for k in ("P", "n_inliers", "best_hyp"):
+        np.testing.assert_array_equal(
+            np.asarray(got[k]), np.asarray(expected[k])[0]
+        )
+    assert stats["degraded_batches"] == 0
+    assert stats["recompiles_after_warmup"] == 0
+    _invariant(stats)
+
+
+def test_pose_request_fault_fails_typed_ledger_exact():
+    """A ``serve.request`` crash injected into the pose prep path: the
+    victim fails ALONE with the typed fault, the next request is served
+    from the intact warm cache, and every accepted request lands in
+    exactly one outcome bin. A killed prep WORKER is the StageFailure
+    case: only the in-flight pose request fails, the stage restarts."""
+    faultinject.inject("serve.request", "crash", at=1)
+    with make_pose_engine(
+        n_hypotheses=PRIMARY, degraded_hypotheses=DEGRADED,
+        max_batch=1, host_workers=1,
+    ) as eng:
+        eng.warmup(pose_bucket_specs((128,)))
+        warm = eng.compile_count
+        victim = eng.submit(_pose_request(seed=5))
+        with pytest.raises(faultinject.InjectedFault):
+            victim.result(timeout=60)
+        ok = eng.submit(_pose_request(seed=6)).result(timeout=60)
+        assert eng.compile_count == warm
+        stats = eng.report()
+    assert bool(ok["found"])
+    assert stats["failed"] == 1 and stats["completed"] == 1
+    assert stats["recompiles_after_warmup"] == 0
+    _invariant(stats)
+
+    faultinject.clear()
+    faultinject.inject("serve.worker.crash", "crash", at=1)
+    with make_pose_engine(
+        n_hypotheses=PRIMARY, degraded_hypotheses=DEGRADED,
+        max_batch=1, host_workers=1,
+    ) as eng:
+        eng.warmup(pose_bucket_specs((128,)))
+        warm = eng.compile_count
+        victim = eng.submit(_pose_request(seed=7))
+        with pytest.raises(StageFailure) as ei:
+            victim.result(timeout=60)
+        assert ei.value.stage == "prep" and not ei.value.hang
+        ok = eng.submit(_pose_request(seed=8)).result(timeout=60)
+        assert eng.compile_count == warm
+        stats = eng.report()
+    assert bool(ok["found"])
+    assert stats["stage_restarts"]["prep"] == 1
+    assert stats["failed"] == 1 and stats["completed"] == 1
+    assert stats["recompiles_after_warmup"] == 0
+    _invariant(stats)
+
+
+def test_pose_engine_rejects_inverted_rungs():
+    with pytest.raises(ValueError, match="below primary"):
+        make_pose_engine(n_hypotheses=8, degraded_hypotheses=8)
