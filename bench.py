@@ -110,6 +110,7 @@ from ncnet_tpu.ops.accounting import (  # noqa: E402
     compute_dtype,
     peak_flops,
     train_step_flops,
+    train_step_flops_for_batch,
 )
 
 # Named flagship configs (reference README.md:42,48 — PF-Pascal trains
@@ -217,6 +218,22 @@ def main():
                    help="with --nc-topk: symmetric/mutual band selection "
                         "(union of per-A and per-B ranks, swap-closed up "
                         "to capacity) vs plain per-A top-K")
+    p.add_argument("--refine", type=int, default=0, metavar="R",
+                   help="coarse-to-fine training step (ncnet_tpu.refine): "
+                        "pool features by R, run the coarse band at "
+                        "--refine-topk, re-score the survivors at high "
+                        "res. Takes precedence over --nc-topk. The "
+                        "analytic count and MFU use the refined total "
+                        "(ops.accounting.refine_train_step_flops); the "
+                        "JSON records refine geometry and the dense-"
+                        "equivalent count, mirroring the --nc-topk "
+                        "accounting. 0 = off")
+    p.add_argument("--refine-topk", type=int, default=16,
+                   dest="refine_topk", metavar="K",
+                   help="with --refine: coarse-band width")
+    p.add_argument("--refine-radius", type=int, default=0,
+                   dest="refine_radius",
+                   help="with --refine: extra window reach in coarse cells")
     p.add_argument("--bf16", action=argparse.BooleanOptionalAction,
                    default=True,
                    help="bf16 features/correlation/NC compute with f32 "
@@ -297,7 +314,17 @@ def _run(args):
         symmetric_batch=not args.sym_seq,
         nc_topk=args.nc_topk,
         nc_topk_mutual=args.nc_topk_mutual,
+        refine_factor=args.refine,
+        refine_topk=args.refine_topk,
+        refine_radius=args.refine_radius,
     )
+    if args.refine and (args.image_size // 16) % args.refine:
+        raise SystemExit(
+            f"--image_size {args.image_size} gives a "
+            f"{args.image_size // 16}-cell feature grid, which does not "
+            f"divide by --refine {args.refine} (at 400x400 use 5; at "
+            "128x128 use 2 or 4)"
+        )
     params = init_immatchnet(jax.random.PRNGKey(0), config)
     optimizer = make_optimizer()
     state = create_train_state(params, optimizer)
@@ -383,11 +410,19 @@ def _run(args):
 
     pairs_per_sec = batch_size * n_steps / dt
     grid = size // 16
-    step_flops = train_step_flops(
-        batch_size, preset["kernels"], preset["channels"],
-        grid=grid, image=size, from_features=from_features,
-        nc_topk=args.nc_topk,
-    )
+    if args.refine:
+        # derives grid/feat_ch from the batch and branches to
+        # refine_train_step_flops — the same number the training loop's
+        # MFU gauge reports for a --refine run
+        step_flops = train_step_flops_for_batch(
+            config, batch, from_features=from_features
+        )
+    else:
+        step_flops = train_step_flops(
+            batch_size, preset["kernels"], preset["channels"],
+            grid=grid, image=size, from_features=from_features,
+            nc_topk=args.nc_topk,
+        )
     achieved_flops = step_flops * n_steps / dt
     mfu = achieved_flops / V5E_BF16_PEAK_FLOPS
     # the dual-MFU pair: the same achieved rate against both dtype peaks,
@@ -409,7 +444,21 @@ def _run(args):
         "bench_mfu_vs_f32_peak", "bench analytic MFU vs v5e f32 peak"
     ).set(mfu_f32)
     sparse_extras = {}
-    if args.nc_topk:
+    if args.refine:
+        from ncnet_tpu.ops.accounting import refine_window
+
+        dense_flops = train_step_flops(
+            batch_size, preset["kernels"], preset["channels"],
+            grid=grid, image=size, from_features=from_features,
+        )
+        grid_lo = grid // args.refine
+        sparse_extras = {
+            "refine_factor": args.refine,
+            "refine_topk": min(args.refine_topk, grid_lo**2),
+            "refine_window": refine_window(args.refine, args.refine_radius),
+            "analytic_tflop_per_step_dense": round(dense_flops / 1e12, 2),
+        }
+    elif args.nc_topk:
         # the dense-vs-band analytic pair: BENCH_r*.json trajectories stay
         # comparable across sparse and dense runs (mirrors the
         # --feature-cache accounting, which also reports the reduced count)

@@ -1,0 +1,118 @@
+"""Latency + analytic FLOPs of the coarse-to-fine refined forward.
+
+Times the three serving-tier programs of the quality ladder
+(serve/engine.py) at the same bucket geometry — dense, sparse band at
+K, and refined (pooled coarse band at K + high-res window re-score,
+ncnet_tpu.refine) — and prints each tier's analytic match FLOPs from
+the same ledger the auditor cross-checks (`ops.accounting`), so the
+measured step time can be read against the compute the tier actually
+buys. The dense-equivalent ledger entry is the factor-1 complete-band
+form, which tests/test_refine.py pins bitwise to the dense pipeline.
+
+Run: python benchmarks/micro_refine.py [--image 128] [--factor 2]
+     [--topk 8] [--radius 0] [--batch 4] [--steps 20]
+Prints one JSON line per tier.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--image", type=int, default=128)
+    ap.add_argument("--factor", type=int, default=2)
+    ap.add_argument("--topk", type=int, default=8)
+    ap.add_argument("--radius", type=int, default=0)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--cnn", default="patch16")
+    args = ap.parse_args()
+
+    import jax
+
+    from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+    from ncnet_tpu.ops.accounting import refine_match_flops
+    from ncnet_tpu.serve import make_serve_match_step
+
+    base = ImMatchNetConfig(
+        feature_extraction_cnn=args.cnn,
+        ncons_kernel_sizes=(5, 5, 5),
+        ncons_channels=(16, 16, 1),
+    )
+    grid = args.image // 16
+    if grid % args.factor:
+        raise SystemExit(
+            f"grid {grid} does not divide by --factor {args.factor}"
+        )
+    params = init_immatchnet(jax.random.PRNGKey(0), base)
+    feat_ch = 256 if args.cnn == "patch16" else 1024
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "source_image": rng.rand(
+            args.batch, args.image, args.image, 3
+        ).astype(np.float32),
+        "target_image": rng.rand(
+            args.batch, args.image, args.image, 3
+        ).astype(np.float32),
+    }
+
+    def ledger(cfg):
+        if cfg.refine_factor:
+            return refine_match_flops(
+                args.batch, cfg.ncons_kernel_sizes, cfg.ncons_channels,
+                grid_hi=grid, factor=cfg.refine_factor,
+                nc_topk=cfg.refine_topk, radius=cfg.refine_radius,
+                feat_ch=feat_ch, image=args.image, cnn=args.cnn,
+            )
+        # dense / band through the SAME ledger: factor 1 is the band,
+        # and the complete band is the dense-equivalent form
+        k = cfg.nc_topk if cfg.nc_topk else grid * grid
+        return refine_match_flops(
+            args.batch, cfg.ncons_kernel_sizes, cfg.ncons_channels,
+            grid_hi=grid, factor=1, nc_topk=k, feat_ch=feat_ch,
+            image=args.image, cnn=args.cnn,
+        )
+
+    tiers = {
+        "dense": base,
+        f"band_k{args.topk}": base.replace(nc_topk=args.topk),
+        f"refined_r{args.factor}_k{args.topk}": base.replace(
+            refine_factor=args.factor,
+            refine_topk=args.topk,
+            refine_radius=args.radius,
+        ),
+    }
+    for name, cfg in tiers.items():
+        step = jax.jit(make_serve_match_step(cfg))  # nclint: disable=recompile-hazard -- one compile per tier is the point of the sweep; each config is a distinct program
+        t0 = time.perf_counter()
+        jax.tree_util.tree_map(np.asarray, step(params, batch))
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            out = step(params, batch)
+        jax.tree_util.tree_map(np.asarray, out)
+        dt = (time.perf_counter() - t0) / args.steps
+        print(json.dumps({
+            "metric": "refine_serve_step_ms",
+            "tier": name,
+            "value": round(dt * 1e3, 2),
+            "unit": "ms",
+            "pairs_per_s": round(args.batch / dt, 1),
+            "analytic_match_gflops": round(ledger(cfg) / 1e9, 4),
+            "grid": grid,
+            "batch": args.batch,
+            "compile_s": round(compile_s, 1),
+        }))
+
+
+if __name__ == "__main__":
+    main()

@@ -582,7 +582,8 @@ def _feature_batch():
     return {"source_features": feat, "target_features": feat.copy()}
 
 
-def _build_train(nc_topk=0, from_features=False, half_precision=False):
+def _build_train(nc_topk=0, from_features=False, half_precision=False,
+                 refine=False):
     from ncnet_tpu.ops.accounting import train_step_flops_for_batch
     from ncnet_tpu.train.step import (
         create_train_state,
@@ -590,7 +591,14 @@ def _build_train(nc_topk=0, from_features=False, half_precision=False):
         make_train_step,
     )
 
-    config = _audit_config(nc_topk=nc_topk, half_precision=half_precision)
+    refine_overrides = (
+        # coarse-to-fine geometry at audit size: 4x4 fine grid pooled by
+        # 2 -> 2x2 coarse, the full 4-wide coarse band, radius 0
+        {"refine_factor": 2, "refine_topk": 4} if refine else {}
+    )
+    config = _audit_config(
+        nc_topk=nc_topk, half_precision=half_precision, **refine_overrides
+    )
     params = _audit_params(config)
     optimizer = make_optimizer()
     state = create_train_state(params, optimizer)
@@ -633,6 +641,43 @@ def _build_serve():
             argnum: "single-use padded request batch"
             for argnum in SERVE_DONATE_ARGNUMS
         },
+    )
+
+
+def _build_refine_serve():
+    import jax
+
+    from ncnet_tpu.ops.accounting import refine_match_flops
+    from ncnet_tpu.serve.engine import (
+        SERVE_DONATE_ARGNUMS,
+        make_serve_match_step,
+    )
+
+    # the refined quality tier (ncnet_tpu.refine): the third pre-warmed
+    # program family the engine's QualityLadder dispatches to
+    config = _audit_config(refine_factor=2, refine_topk=4)
+    params = _audit_params(config)
+    fn = jax.jit(
+        make_serve_match_step(config), donate_argnums=SERVE_DONATE_ARGNUMS
+    )
+    return BuiltProgram(
+        fn=fn,
+        args=(params, _image_batch()),
+        donate_expect={
+            argnum: "single-use padded request batch"
+            for argnum in SERVE_DONATE_ARGNUMS
+        },
+        expected_flops=refine_match_flops(
+            _BATCH,
+            config.ncons_kernel_sizes,
+            config.ncons_channels,
+            grid_hi=_GRID,
+            factor=2,
+            nc_topk=4,
+            feat_ch=_FEAT_CH,
+            image=_IMAGE_SIDE,
+            cnn="patch16",
+        ),
     )
 
 
@@ -754,9 +799,21 @@ PROGRAMS: Dict[str, ProgramSpec] = {
             ),
         ),
         ProgramSpec(
+            "train/refine",
+            "coarse-to-fine (refine_factor) training step from cached "
+            "features",
+            lambda: _build_train(refine=True, from_features=True),
+        ),
+        ProgramSpec(
             "serve/bucket",
             "serving engine bucket program (the warmup-compiled apply)",
             _build_serve,
+        ),
+        ProgramSpec(
+            "refine/rescore",
+            "refined serving program: coarse band + high-res window "
+            "rescore (the quality ladder's top rung)",
+            _build_refine_serve,
         ),
         ProgramSpec(
             "serve/sharded",

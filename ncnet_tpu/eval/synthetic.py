@@ -96,3 +96,46 @@ def synthetic_pck_vs_topk(params, config, batches, ks, alpha=0.1, n_side=4):
         )
         for k in ks
     }
+
+
+def synthetic_pck_vs_refine(
+    params, config, batches, factors, ks, radius=0, alpha=0.1, n_side=4
+):
+    """Synthetic-transfer PCK across (pool factor, coarse band width)
+    pairs — the accuracy/compute surface of coarse-to-fine refinement
+    (ncnet_tpu.refine), same protocol as `synthetic_pck_vs_topk`.
+
+    Args:
+      batches: a list (or reusable loader) of shift-annotated batches —
+        the SAME pairs are scored at every cell so the sweep isolates
+        the refinement geometry.
+      factors: iterable of ``refine_factor`` pool factors; 0 = the dense
+        baseline (scored once, keyed ``(0, 0)``).
+      ks: iterable of ``refine_topk`` coarse-band widths (ignored for
+        factor 0).
+
+    Returns:
+      ``{(factor, k): mean_pck}``. The factor-1 row at ``k >= hB*wB``
+      re-scores a complete band through a single-entry window, so it
+      must equal the dense entry — the sweep's sanity anchor.
+    """
+    cached = list(batches)
+    results = {}
+    for factor in factors:
+        if int(factor) == 0:
+            results[(0, 0)] = evaluate_synthetic(
+                params, config.replace(refine_factor=0), cached, alpha,
+                n_side,
+            )
+            continue
+        for k in ks:
+            results[(int(factor), int(k))] = evaluate_synthetic(
+                params,
+                config.replace(
+                    refine_factor=int(factor),
+                    refine_topk=int(k),
+                    refine_radius=int(radius),
+                ),
+                cached, alpha, n_side,
+            )
+    return results

@@ -10,12 +10,20 @@ plus the ``from_features`` modes of ``train/loss.py`` and
 ``train/step.py``.
 """
 
-from ncnet_tpu.features.extract import make_batch_extractor, populate_store
+from ncnet_tpu.features.extract import (
+    make_batch_extractor,
+    make_multires_batch_extractor,
+    populate_store,
+    populate_store_multires,
+)
 from ncnet_tpu.features.store import (
     FeatureCacheMismatch,
     FeatureStore,
     GalleryFeatureStore,
+    MultiResFeatureStore,
+    MultiResGalleryFeatureStore,
     feature_dtype_name,
+    pooled_digest,
     trunk_digest,
 )
 
@@ -23,8 +31,13 @@ __all__ = [
     "FeatureCacheMismatch",
     "FeatureStore",
     "GalleryFeatureStore",
+    "MultiResFeatureStore",
+    "MultiResGalleryFeatureStore",
     "feature_dtype_name",
     "make_batch_extractor",
+    "make_multires_batch_extractor",
+    "pooled_digest",
     "populate_store",
+    "populate_store_multires",
     "trunk_digest",
 ]

@@ -32,6 +32,92 @@ def make_batch_extractor(params, config):
     return jax.jit(_extract)
 
 
+def make_multires_batch_extractor(params, config, factor):
+    """Jitted ``image batch -> (hi, lo) feature batches``: ONE trunk
+    forward per image, the pooled tier derived on device in the same
+    program (``refine.pool.pool_features`` — the two tiers can never
+    come from different trunks because they come from the same pass)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ncnet_tpu.refine.pool import pool_features
+
+    def _extract(images):
+        if images.dtype == jnp.uint8:
+            from ncnet_tpu.ops.image import imagenet_normalize
+
+            images = imagenet_normalize(images.astype(jnp.float32))
+        hi = extract_features(params, config, images)
+        return hi, pool_features(
+            hi, factor, normalize=config.normalize_features
+        )
+
+    return jax.jit(_extract)
+
+
+def populate_store_multires(store, params, config, dataset, batch_size=8,
+                            log_every=0):
+    """`populate_store` for a :class:`MultiResFeatureStore`: every
+    missing pair gets BOTH resolution tiers from one trunk pass per
+    image. Returns the count of pairs extracted."""
+    if len(dataset) != store.num_items:
+        raise ValueError(
+            f"dataset has {len(dataset)} items but the store manifest "
+            f"records {store.num_items}"
+        )
+    missing = store.missing()
+    if not missing:
+        return 0
+    extractor = make_multires_batch_extractor(params, config, store.factor)
+    out_dtype = store.dtype
+    metrics = default_registry()
+    m_shards = metrics.counter(
+        "feature_shards_written_total", "feature shards durably written"
+    )
+    m_bytes = metrics.counter(
+        "feature_shard_bytes_total", "feature payload bytes written"
+    )
+    t0 = time.perf_counter()
+    done = 0
+    for lo in range(0, len(missing), batch_size):
+        group = missing[lo : lo + batch_size]
+        with trace.span("features/extract_batch"):
+            samples = [dataset[i] for i in group]
+            pad = batch_size - len(group)
+            if pad:
+                samples = samples + [samples[-1]] * pad
+            src = np.stack([s["source_image"] for s in samples])
+            tgt = np.stack([s["target_image"] for s in samples])
+            hi, low = extractor(np.concatenate([src, tgt], axis=0))
+            hi, low = np.asarray(hi), np.asarray(low)
+        if hi.dtype != out_dtype:
+            raise RuntimeError(
+                f"extractor produced {hi.dtype} but the store holds "
+                f"{out_dtype}; the config does not match the manifest"
+            )
+        with trace.span("features/store_put"):
+            for j, idx in enumerate(group):
+                store.put(
+                    idx,
+                    hi[j], hi[batch_size + j],
+                    low[j], low[batch_size + j],
+                )
+                m_shards.inc(2)
+                m_bytes.inc(
+                    int(hi[j].nbytes) + int(hi[batch_size + j].nbytes)
+                    + int(low[j].nbytes) + int(low[batch_size + j].nbytes)
+                )
+        done += len(group)
+        if log_every and (done // batch_size) % log_every == 0:
+            rate = done / max(time.perf_counter() - t0, 1e-9)
+            print(
+                f"[features] {done}/{len(missing)} pairs extracted "
+                f"({rate:.1f} pairs/s, 2 resolutions)",
+                flush=True,
+            )
+    return done
+
+
 def populate_store(store, params, config, dataset, batch_size=8,
                    log_every=0):
     """Extract and durably write every missing shard; returns the count

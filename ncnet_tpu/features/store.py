@@ -371,3 +371,151 @@ class GalleryFeatureStore:
             durable.read_verified_bytes(self.shard_path(image_path)),
             self.manifest["feature_dtype"],
         )
+
+
+# ---------------------------------------------------------------------------
+# multi-resolution stores (ncnet_tpu.refine): one trunk, two resolutions
+
+
+def pooled_digest(base_digest, factor):
+    """Digest of the POOLED tier derived from a high-res tier's digest.
+
+    The low-res features are a pure function of the high-res ones
+    (``refine.pool.pool_features``: r x r mean + re-L2-norm), so their
+    identity is exactly (high-res identity, pool factor). Deriving the
+    digest this way makes staleness transitive BY CONSTRUCTION: any
+    change that re-digests the high-res tier (new trunk weights, other
+    dtype, flags) re-digests the pooled tier too, and a leftover pooled
+    directory from an older trunk refuses to open — there is no way to
+    pair fresh high-res shards with stale coarse ones.
+    """
+    if int(factor) < 1:
+        raise ValueError(f"pool factor must be >= 1, got {factor}")
+    h = hashlib.sha256(str(base_digest).encode("ascii"))
+    h.update(f":avgpool{int(factor)}".encode("ascii"))
+    return h.hexdigest()
+
+
+class MultiResFeatureStore:
+    """Two digest-linked pair stores: the trunk features and their pool.
+
+    Layout: ``root/hi`` holds the full-resolution `FeatureStore` under
+    the trunk digest; ``root/lo{factor}`` holds the pooled tier under
+    `pooled_digest`. Both tiers of a pair are written together by one
+    `put` (the extractor pools on device in the same jitted pass), and
+    `missing` reports a pair until BOTH tiers hold it — a crash between
+    the two writes re-extracts that pair instead of serving a torn
+    resolution ladder. Opening either tier stale raises
+    :class:`FeatureCacheMismatch` exactly like the single-tier stores.
+    """
+
+    def __init__(self, hi, lo, factor):
+        self.hi = hi
+        self.lo = lo
+        self.factor = int(factor)
+
+    @staticmethod
+    def _roots(root, factor):
+        root = os.path.abspath(root)
+        return (
+            os.path.join(root, "hi"),
+            os.path.join(root, f"lo{int(factor)}"),
+        )
+
+    @classmethod
+    def create(cls, root, digest, config, image_size, num_items, factor):
+        hi_root, lo_root = cls._roots(root, factor)
+        hi = FeatureStore.create(
+            hi_root, digest, config, image_size, num_items
+        )
+        lo = FeatureStore.create(
+            lo_root, pooled_digest(digest, factor), config, image_size,
+            num_items,
+        )
+        return cls(hi, lo, factor)
+
+    @classmethod
+    def open_store(cls, root, factor, expected_digest=None, num_items=None):
+        hi_root, lo_root = cls._roots(root, factor)
+        hi = FeatureStore.open_store(
+            hi_root, expected_digest=expected_digest, num_items=num_items
+        )
+        lo = FeatureStore.open_store(
+            lo_root,
+            expected_digest=(
+                None
+                if expected_digest is None
+                else pooled_digest(expected_digest, factor)
+            ),
+            num_items=num_items,
+        )
+        return cls(hi, lo, factor)
+
+    @classmethod
+    def open_or_create(cls, root, digest, config, image_size, num_items,
+                       factor):
+        """Open a matching two-tier store, or create an empty one. An
+        EXISTING manifest with a different digest (either tier) raises."""
+        try:
+            return cls.open_store(
+                root, factor, expected_digest=digest, num_items=num_items
+            )
+        except FileNotFoundError:
+            return cls.create(
+                root, digest, config, image_size, num_items, factor
+            )
+
+    @property
+    def num_items(self):
+        return self.hi.num_items
+
+    @property
+    def dtype(self):
+        return self.hi.dtype
+
+    def has(self, idx):
+        return self.hi.has(idx) and self.lo.has(idx)
+
+    def missing(self):
+        return [i for i in range(self.num_items) if not self.has(i)]
+
+    def complete(self):
+        return not self.missing()
+
+    def put(self, idx, source_hi, target_hi, source_lo, target_lo):
+        """Durably write one pair at BOTH resolutions (idempotent)."""
+        self.hi.put(idx, source_hi, target_hi)
+        self.lo.put(idx, source_lo, target_lo)
+
+    def get(self, idx):
+        """``((source_hi, target_hi), (source_lo, target_lo))``."""
+        return self.hi.get(idx), self.lo.get(idx)
+
+
+class MultiResGalleryFeatureStore:
+    """`GalleryFeatureStore` parity for the two-tier layout (InLoc)."""
+
+    def __init__(self, hi, lo, factor):
+        self.hi = hi
+        self.lo = lo
+        self.factor = int(factor)
+
+    @classmethod
+    def open_or_create(cls, root, digest, config, factor):
+        hi_root, lo_root = MultiResFeatureStore._roots(root, factor)
+        hi = GalleryFeatureStore.open_or_create(hi_root, digest, config)
+        lo = GalleryFeatureStore.open_or_create(
+            lo_root, pooled_digest(digest, factor), config
+        )
+        return cls(hi, lo, factor)
+
+    def has(self, image_path):
+        return self.hi.has(image_path) and self.lo.has(image_path)
+
+    def put(self, image_path, features_hi, features_lo):
+        self.hi.put(image_path, features_hi)
+        self.lo.put(image_path, features_lo)
+
+    def get(self, image_path):
+        """``(features_hi, features_lo)``, each digest-verified."""
+        return self.hi.get(image_path), self.lo.get(image_path)

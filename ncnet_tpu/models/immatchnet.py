@@ -83,6 +83,18 @@ class ImMatchNetConfig:
     # resolves back to 'xla' on non-TPU backends). Only consulted when
     # nc_topk > 0.
     band_impl: str = "xla"
+    # Multi-resolution coarse-to-fine refinement (ncnet_tpu.refine,
+    # XRCN-style): pool features by this factor, run the sparse band
+    # (width refine_topk) at the coarse resolution, then re-score only
+    # the surviving neighbourhoods against the high-res features inside
+    # (2*refine_radius+1)-coarse-cell windows. 0 = off; takes precedence
+    # over nc_topk when set (the coarse tier IS a band — nc_topk stays
+    # the standard tier's knob). factor 1 + radius 0 reduces BITWISE to
+    # the plain band at K = refine_topk (the exactness contract,
+    # tests/test_refine.py). Incompatible with relocalization configs.
+    refine_factor: int = 0
+    refine_topk: int = 16
+    refine_radius: int = 0
 
     def replace(self, **kw):
         return dataclasses.replace(self, **kw)
@@ -133,9 +145,24 @@ def match_pipeline(nc_params, config: ImMatchNetConfig, feat_a, feat_b):
     off-band, identical to the dense output at ``K = hB*wB``. The
     training loss bypasses this densification and scores the band
     directly (train/loss.py).
+
+    With ``config.refine_factor > 0`` (ncnet_tpu.refine, takes
+    precedence) the coarse band runs on POOLED features and the
+    surviving neighbourhoods are re-scored against the full-resolution
+    features; the returned correlation is then at the FINE grid —
+    ``corr_to_matches`` and every other consumer are generic over grid
+    size and need no changes.
     """
     dtype = jnp.bfloat16 if config.half_precision else None
     k = config.relocalization_k_size
+    if getattr(config, "refine_factor", 0):
+        from ncnet_tpu.refine.pipeline import refine_match_pipeline
+        from ncnet_tpu.sparse.pipeline import sparse_corr_to_dense
+
+        values, indices, grid_b = refine_match_pipeline(
+            nc_params, config, feat_a, feat_b
+        )
+        return sparse_corr_to_dense(values, indices, grid_b)
     if getattr(config, "nc_topk", 0):
         from ncnet_tpu.sparse.pipeline import (
             sparse_corr_to_dense,

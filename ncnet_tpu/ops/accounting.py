@@ -138,6 +138,22 @@ def train_step_flops_for_batch(config, batch, from_features=False,
         image = int(arr.shape[1])
         grid = max(image // 16, 1)
         feat_ch = 256 if cnn == "patch16" else 1024
+    if int(getattr(config, "refine_factor", 0)):
+        # coarse-to-fine step (ncnet_tpu.refine): the batch carries the
+        # FINE grid; the coarse band and the rescore window are config
+        return refine_train_step_flops(
+            b,
+            config.ncons_kernel_sizes,
+            config.ncons_channels,
+            grid_hi=grid,
+            factor=int(config.refine_factor),
+            nc_topk=int(config.refine_topk),
+            radius=int(getattr(config, "refine_radius", 0)),
+            feat_ch=feat_ch,
+            image=image,
+            cnn=cnn,
+            from_features=from_features,
+        )
     return train_step_flops(
         b,
         config.ncons_kernel_sizes,
@@ -150,6 +166,93 @@ def train_step_flops_for_batch(config, batch, from_features=False,
         cnn=cnn,
         trunk_trainable=trunk_trainable,
     )
+
+
+# ---------------------------------------------------------------------------
+# coarse-to-fine refinement (ncnet_tpu.refine)
+
+
+def refine_window(factor, radius=0):
+    """Fine cells re-scored per surviving coarse candidate:
+    ``(factor * (2*radius + 1))^2`` (`refine.rescore.refine_window_indices`)."""
+    return (int(factor) * (2 * int(radius) + 1)) ** 2
+
+
+def _coarse_band_flops(kernels, channels, grid_lo, nc_topk, feat_ch):
+    """One pair's coarse tier: correlation einsum + symmetric NC band
+    forward at the pooled grid (the pooling itself is reduction work —
+    zero contraction FLOPs)."""
+    corr = 2.0 * grid_lo**4 * feat_ch
+    n_b = min(int(nc_topk), grid_lo**2)
+    nc_channels = [1, *channels]
+    nc_pass = sum(
+        2.0 * grid_lo**2 * n_b * k**4 * cin * cout
+        for k, cin, cout in zip(kernels, nc_channels[:-1], nc_channels[1:])
+    )
+    return corr, nc_pass
+
+
+def refine_rescore_flops(batch, grid_hi, nc_topk, window, feat_ch):
+    """The rescore contraction (`refine.rescore.refine_rescore`):
+    ``einsum('bhwac,bhwkec->bhwake')`` over the gathered windows —
+    ``2 * grid_hi^2 * K * window * c`` per sample. The window gathers,
+    softmax, argmax and relocation are gather/elementwise work the
+    ledger counts as zero, matching the jaxpr walk's convention."""
+    return float(batch) * 2.0 * grid_hi**2 * int(nc_topk) * int(window) * feat_ch
+
+
+def refine_match_flops(batch, kernels, channels, grid_hi, factor, nc_topk,
+                       radius=0, feat_ch=256, image=0, cnn="patch16",
+                       from_features=False):
+    """Analytic FLOPs (2*MACs) of one refined match pass per batch
+    (the ``refine/rescore`` serving program): 2 trunk forwards (unless
+    fed from the feature store), the coarse correlation + symmetric NC
+    band at the pooled grid, and the high-res rescore contraction.
+    Verified walk-vs-form by `analysis.jaxpr_audit`."""
+    if int(grid_hi) % int(factor):
+        raise ValueError(
+            f"fine grid {grid_hi} does not divide by factor {factor}"
+        )
+    grid_lo = int(grid_hi) // int(factor)
+    trunk = 0.0 if from_features else 2 * trunk_forward_flops(cnn, image)
+    corr, nc_pass = _coarse_band_flops(
+        kernels, channels, grid_lo, nc_topk, feat_ch
+    )
+    rescore = refine_rescore_flops(
+        1, grid_hi, min(int(nc_topk), grid_lo**2),
+        refine_window(factor, radius), feat_ch,
+    )
+    return float(batch) * (trunk + corr + 2 * nc_pass + rescore)
+
+
+def refine_train_step_flops(batch, kernels, channels, grid_hi, factor,
+                            nc_topk, radius=0, feat_ch=256, image=0,
+                            cnn="patch16", from_features=False):
+    """Analytic FLOPs (2*MACs) per refined training step (the
+    ``train/refine`` program): the coarse tier runs pos + neg like the
+    band path — correlation x2, symmetric NC forward x2, band backward
+    at the sparse convention ``2x forward`` (the band VJP computes dx
+    unconditionally) — plus the rescore contraction x2 FORWARD ONLY:
+    the rescore scores are a pure function of the (param-independent)
+    features, so the gain each band value is modulated by is a constant
+    under ``d loss / d params`` and JAX AD prunes the whole einsum from
+    the backward. Verified walk-vs-form by `analysis.jaxpr_audit`."""
+    if int(grid_hi) % int(factor):
+        raise ValueError(
+            f"fine grid {grid_hi} does not divide by factor {factor}"
+        )
+    grid_lo = int(grid_hi) // int(factor)
+    trunk = 0.0 if from_features else 2 * trunk_forward_flops(cnn, image)
+    corr, nc_pass = _coarse_band_flops(
+        kernels, channels, grid_lo, nc_topk, feat_ch
+    )
+    nc_fwd = nc_pass * 2 * 2  # symmetric x (pos + neg)
+    nc_bwd = 2 * nc_fwd
+    rescore = 2 * refine_rescore_flops(  # pos + neg, forward only
+        1, grid_hi, min(int(nc_topk), grid_lo**2),
+        refine_window(factor, radius), feat_ch,
+    )
+    return float(batch) * (trunk + 2 * corr + nc_fwd + nc_bwd + rescore)
 
 
 def pose_ransac_flops(batch, n_pad, n_hypotheses, lo_iters=2):

@@ -1,0 +1,23 @@
+"""Multi-resolution coarse-to-fine correspondence (ROADMAP item [5]).
+
+A coarse sparse-band pass on pooled features plus a gather-only,
+static-shape re-scoring of the surviving neighbourhoods against the
+high-res features — served as the quality tier ABOVE the standard and
+degraded-band programs (scripts/serve.py ``--refine``), trained and
+evaluated through the unchanged band/readout consumers.
+"""
+
+from ncnet_tpu.refine.pipeline import (
+    check_refine_config,
+    refine_match_pipeline,
+)
+from ncnet_tpu.refine.pool import pool_features
+from ncnet_tpu.refine.rescore import refine_rescore, refine_window_indices
+
+__all__ = [
+    "check_refine_config",
+    "pool_features",
+    "refine_match_pipeline",
+    "refine_rescore",
+    "refine_window_indices",
+]

@@ -1,0 +1,86 @@
+"""End-to-end coarse-to-fine refinement pipeline (ROADMAP item [5]).
+
+XRCN-style adaptive-cost correspondence on parts the repo already owns:
+
+  high-res trunk features            (one backbone pass — features/)
+    -> r x r average pool + re-norm  (refine/pool.py, zero contractions)
+    -> sparse band at small K        (the PR-4 coarse pass, sparse/)
+    -> gather-only window re-score   (refine/rescore.py, one contraction)
+    -> fine-grid band readout        (the UNCHANGED sparse consumers)
+
+Everything is jit-static: the band width K, the window ``(r*(2*radius+
+1))^2``, and both grids are config/shape constants, so a refined program
+AOT-compiles and serves from the same warmed-bucket machinery as the
+dense and band programs (serve/engine.py's quality ladder).
+
+With ``refine_factor == 1`` and ``refine_radius == 0`` the pool is an
+identity and every window holds exactly its own candidate, so the
+refined band equals the coarse band BITWISE — chained with the band's
+own ``K = hB*wB`` contract this reduces the whole ladder to the dense
+pipeline, which is the exactness harness in tests/test_refine.py.
+"""
+
+from ncnet_tpu.refine.pool import pool_features
+from ncnet_tpu.refine.rescore import refine_rescore
+from ncnet_tpu.sparse.pipeline import sparse_match_pipeline
+
+
+def check_refine_config(config):
+    """Validate the refine settings before any tracing (the
+    ``check_sparse_config`` discipline: a bad static config should fail
+    at construction, not deep inside jit)."""
+    factor = int(getattr(config, "refine_factor", 0))
+    if factor < 0:
+        raise ValueError(
+            f"refine_factor={factor} is negative; use 0 to disable "
+            "refinement or a positive pool factor"
+        )
+    if not factor:
+        return
+    if int(getattr(config, "refine_topk", 0)) <= 0:
+        raise ValueError(
+            f"refine_topk={getattr(config, 'refine_topk', 0)}: the "
+            "coarse pass needs a positive band width"
+        )
+    if int(getattr(config, "refine_radius", 0)) < 0:
+        raise ValueError(
+            f"refine_radius={getattr(config, 'refine_radius', 0)} is "
+            "negative"
+        )
+    if config.relocalization_k_size > 1:
+        raise ValueError(
+            "refinement does not support relocalization configs: the 4D "
+            "max-pool offsets are a dense-readout construct and the "
+            "refined band already reads out at the fine grid (set "
+            "relocalization_k_size to 0)"
+        )
+
+
+def refine_match_pipeline(nc_params, config, feat_a, feat_b):
+    """High-res features -> refined fine-grid band.
+
+    ``feat_a``/``feat_b`` are the FULL-resolution trunk features; the
+    coarse tier is pooled here, in-program, so one trunk forward (or one
+    feature-store read) serves both resolutions. Returns ``(values,
+    indices, grid_b)`` on the fine grids — densify with
+    ``sparse.pipeline.sparse_corr_to_dense`` for the readout consumers,
+    or score directly with ``sparse.score.band_match_score_per_sample``
+    (the weak-loss path, train/loss.py).
+    """
+    check_refine_config(config)
+    factor = int(config.refine_factor)
+    fa_lo = pool_features(feat_a, factor, normalize=config.normalize_features)
+    fb_lo = pool_features(feat_b, factor, normalize=config.normalize_features)
+    coarse = sparse_match_pipeline(
+        nc_params,
+        # the coarse tier IS the sparse band: same pipeline, band width
+        # taken from refine_topk (nc_topk stays the standard tier's knob)
+        config.replace(refine_factor=0, nc_topk=int(config.refine_topk)),
+        fa_lo,
+        fb_lo,
+    )
+    values, indices, grid_b_lo = coarse
+    return refine_rescore(
+        values, indices, grid_b_lo, feat_a, feat_b,
+        factor, radius=int(getattr(config, "refine_radius", 0)),
+    )

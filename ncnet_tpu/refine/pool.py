@@ -1,0 +1,44 @@
+"""Multi-resolution feature pooling for the coarse-to-fine pipeline.
+
+One trunk forward serves BOTH resolutions of the refinement ladder: the
+high-res feature map is the trunk output, and the low-res map is its
+``r x r`` average pool, re-L2-normalized so the coarse correlation sees
+unit-norm descriptors exactly like the dense path does. Pooling is
+elementwise/reduction work (zero contraction FLOPs — the analytic ledger
+in ``ops/accounting.py`` counts nothing for it), so the coarse tier
+costs one cheap reduce instead of a second backbone pass, and the two
+tiers can never disagree about which trunk produced them.
+"""
+
+import jax.numpy as jnp
+
+from ncnet_tpu.ops.norm import feature_l2norm
+
+
+def pool_features(feats, factor, normalize=True):
+    """``[b, h, w, c]`` features -> ``[b, h/r, w/r, c]`` pooled features.
+
+    ``factor == 1`` returns the input UNCHANGED (a static Python branch):
+    re-normalizing would divide by a computed ~1.0 norm and perturb the
+    last bit, and the equal-resolution case is the refinement pipeline's
+    bitwise exactness anchor (tests/test_refine.py), so identity must be
+    identity. For ``factor > 1`` the grid must divide evenly — a partial
+    edge cell would pool a different support than every interior cell and
+    silently skew the coarse correlation.
+    """
+    r = int(factor)
+    if r < 1:
+        raise ValueError(f"pool factor must be >= 1, got {factor}")
+    if r == 1:
+        return feats
+    b, h, w, c = feats.shape
+    if h % r or w % r:
+        raise ValueError(
+            f"feature grid {h}x{w} does not divide by the refine factor "
+            f"{r}; pick an image size whose feature grid is a multiple "
+            "of the factor"
+        )
+    pooled = jnp.mean(
+        feats.reshape(b, h // r, r, w // r, r, c), axis=(2, 4)
+    )
+    return feature_l2norm(pooled) if normalize else pooled

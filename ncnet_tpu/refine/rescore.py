@@ -1,0 +1,157 @@
+"""Gather-only re-scoring of surviving band neighbourhoods at high res.
+
+The coarse pass (the PR-4 sparse band on pooled features) leaves
+``values/indices [b, hA, wA, K]``: per coarse A-cell, the K consensus-
+filtered B-candidates. Refinement re-reads ONLY those neighbourhoods
+against the high-res features — same no-scatter discipline as the band
+itself: a jit-static ``[b, hA, wA, K, win]`` window pointer table
+(``win = (r * (2*radius + 1))^2``; radius 0 gives the ``[.., K, r^2]``
+block directly under each candidate), off-grid slots resolved to an
+appended all-zero null row, every gather ``mode="promise_in_bounds"``,
+and ONE rescore contraction
+``[b, hA, wA, r^2, c] x [b, hA, wA, K, win, c]`` feeding the MXU.
+
+Each fine A-subcell keeps its coarse candidate's consensus score and
+relocates it to the best window cell, modulated by that cell's softmax
+weight over the window — so a window with one dominant fine cell keeps
+(nearly) the full consensus score there, while a flat window spreads
+confidence thin. The modulation is built to DEGENERATE EXACTLY: a
+single-entry window (equal resolutions, radius 0) has softmax weight
+exactly 1.0, and ``v * 1.0 == v`` bitwise, which is the reduction-to-
+the-band contract tests/test_refine.py pins.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def refine_window_indices(indices, grid_b_lo, grid_b_hi, factor, radius=0):
+    """Fine-grid window pointers for each surviving coarse candidate.
+
+    Args:
+      indices: ``[b, hA, wA, K]`` int32 flat coarse-B indices.
+      grid_b_lo: coarse B grid ``(hB_lo, wB_lo)``.
+      grid_b_hi: fine B grid ``(hB_hi, wB_hi)`` (``grid_b_lo * factor``).
+      factor: resolution ratio r (>= 1).
+      radius: extra window reach in COARSE cells around the candidate.
+
+    Returns ``(widx, valid)``: ``widx [b, hA, wA, K, win]`` int32 flat
+    fine-B indices with off-grid slots set to the null index
+    ``hB_hi * wB_hi`` (the caller's zero-row gather makes them exact
+    zeros), and ``valid`` the matching bool mask. ``win`` is jit-static:
+    ``(factor * (2*radius + 1))^2``.
+    """
+    h_lo, w_lo = int(grid_b_lo[0]), int(grid_b_lo[1])
+    h_hi, w_hi = int(grid_b_hi[0]), int(grid_b_hi[1])
+    r = int(factor)
+    if (h_lo * r, w_lo * r) != (h_hi, w_hi):
+        raise ValueError(
+            f"fine grid {h_hi}x{w_hi} is not the coarse grid "
+            f"{h_lo}x{w_lo} times the factor {r}"
+        )
+    side = r * (2 * int(radius) + 1)
+    pi = indices // w_lo  # [b, hA, wA, K] coarse B row/col
+    pj = indices % w_lo
+    off = jnp.arange(side, dtype=jnp.int32) - jnp.int32(int(radius) * r)
+    fi = pi[..., None] * r + off  # [b, hA, wA, K, side]
+    fj = pj[..., None] * r + off
+    valid = (
+        ((fi >= 0) & (fi < h_hi))[..., :, None]
+        & ((fj >= 0) & (fj < w_hi))[..., None, :]
+    )
+    flat = fi[..., :, None] * w_hi + fj[..., None, :]
+    widx = jnp.where(valid, flat, h_hi * w_hi).astype(jnp.int32)
+    b, ha, wa, k = indices.shape
+    return (
+        widx.reshape(b, ha, wa, k, side * side),
+        valid.reshape(b, ha, wa, k, side * side),
+    )
+
+
+def refine_rescore(values, indices, grid_b_lo, feat_a_hi, feat_b_hi,
+                   factor, radius=0):
+    """Coarse band + high-res features -> fine-grid refined band.
+
+    Args:
+      values, indices: ``[b, hA_lo, wA_lo, K]`` the filtered coarse band
+        (``sparse.pipeline.sparse_match_pipeline`` output).
+      grid_b_lo: the coarse B grid the indices address.
+      feat_a_hi, feat_b_hi: ``[b, h*r, w*r, c]`` high-res features.
+      factor, radius: window geometry (see `refine_window_indices`).
+
+    Returns ``(values_f, indices_f, grid_b_hi)``: a ``[b, hA_hi, wA_hi,
+    K]`` band on the FINE grids — the same dense-regular representation
+    the sparse readout consumes (``sparse_corr_to_dense`` ->
+    ``corr_to_matches``), so every downstream consumer is unchanged.
+    """
+    b, ha_lo, wa_lo, k = values.shape
+    _, ha_hi, wa_hi, c = feat_a_hi.shape
+    _, hb_hi, wb_hi, _ = feat_b_hi.shape
+    r = int(factor)
+    if (ha_lo * r, wa_lo * r) != (ha_hi, wa_hi):
+        raise ValueError(
+            f"fine A grid {ha_hi}x{wa_hi} is not the coarse band grid "
+            f"{ha_lo}x{wa_lo} times the factor {r}"
+        )
+    widx, valid = refine_window_indices(
+        indices, grid_b_lo, (hb_hi, wb_hi), r, radius
+    )
+    win = widx.shape[-1]
+
+    # window features via the band-gather discipline (ops/band.py): an
+    # appended all-zero row makes every null pointer read exact zeros,
+    # and the pointer table is in-bounds BY CONSTRUCTION, so the gather
+    # promises rather than clamps
+    fb_pad = jnp.concatenate(
+        [
+            feat_b_hi.reshape(b, hb_hi * wb_hi, c),
+            jnp.zeros((b, 1, c), feat_b_hi.dtype),
+        ],
+        axis=1,
+    )
+    fb_win = jnp.take_along_axis(
+        fb_pad,
+        widx.reshape(b, ha_lo * wa_lo * k * win)[..., None],
+        axis=1,
+        mode="promise_in_bounds",
+    ).reshape(b, ha_lo, wa_lo, k, win, c)
+
+    # the r^2 fine A-subcells under each coarse A-cell: pure relabeling
+    fa = (
+        feat_a_hi.reshape(b, ha_lo, r, wa_lo, r, c)
+        .transpose(0, 1, 3, 2, 4, 5)
+        .reshape(b, ha_lo, wa_lo, r * r, c)
+    )
+
+    # THE rescore contraction — the only counted FLOPs of refinement
+    # (ops.accounting.refine_rescore_flops): 2 * nA_hi * K * win * c
+    s = jnp.einsum(
+        "bhwac,bhwkec->bhwake", fa, fb_win,
+        preferred_element_type=fa.dtype,
+    )  # [b, hA_lo, wA_lo, r^2, K, win]
+    s = jnp.where(
+        valid[:, :, :, None, :, :], s, jnp.asarray(-jnp.inf, s.dtype)
+    )
+    # per-(subcell, candidate) softmax over the window: a single-entry
+    # window gives exactly 1.0 (exp(0)/exp(0)) — the bitwise anchor
+    gain = jax.nn.softmax(s, axis=-1)
+    best = jnp.argmax(s, axis=-1)  # [b, hA_lo, wA_lo, r^2, K]
+    g = jnp.take_along_axis(
+        gain, best[..., None], axis=-1, mode="promise_in_bounds"
+    )[..., 0]
+    idx_f = jnp.take_along_axis(
+        jnp.broadcast_to(widx[:, :, :, None, :, :], s.shape),
+        best[..., None],
+        axis=-1,
+        mode="promise_in_bounds",
+    )[..., 0]
+    vals_f = values[:, :, :, None, :] * g  # consensus score, modulated
+
+    def to_fine(x):  # [b, hA_lo, wA_lo, r^2, K] -> [b, hA_hi, wA_hi, K]
+        return (
+            x.reshape(b, ha_lo, wa_lo, r, r, k)
+            .transpose(0, 1, 3, 2, 4, 5)
+            .reshape(b, ha_hi, wa_hi, k)
+        )
+
+    return to_fine(vals_f), to_fine(idx_f), (hb_hi, wb_hi)

@@ -53,6 +53,7 @@ from ncnet_tpu.serve.resilience import (
     DeadlineExceeded,
     HysteresisController,
     LatencyEstimator,
+    QualityLadder,
     ReplicaDown,
     RequestShed,
     ServeResilienceError,
@@ -72,6 +73,7 @@ __all__ = [
     "LatencyEstimator",
     "MicroBatch",
     "MicroBatcher",
+    "QualityLadder",
     "ReplicaDown",
     "ReplicaView",
     "RequestShed",

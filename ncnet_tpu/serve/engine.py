@@ -92,6 +92,7 @@ from ncnet_tpu.serve.resilience import (
     DeadlineExceeded,
     HysteresisController,
     LatencyEstimator,
+    QualityLadder,
     ReplicaDown,
     RequestShed,
     StageFailure,
@@ -181,6 +182,13 @@ class ServeEngine:
       ``apply_fn``) the `HysteresisController` flips dispatch to under
       sustained queue pressure; pass ``degrade_controller=`` to tune the
       thresholds. Both variants compile at `warmup()`.
+    * ``refined_apply_fn`` — the RICHER program (PR 14,
+      `ncnet_tpu.refine`): a third pre-warmed family per bucket above
+      the standard one. With it, dispatch is steered by a
+      `QualityLadder` (pass ``quality_controller=`` to tune/replace)
+      walking refined <-> standard <-> degraded one rung per sustained
+      pressure change — quality itself becomes the SLO knob, at zero
+      recompiles because every rung's programs compile at `warmup()`.
     * ``hang_timeout`` — enable the dispatch heartbeat `Watchdog`. Must
       exceed the worst-case single-batch latency INCLUDING any live
       compile of an unwarmed bucket, or a legitimately long device call
@@ -241,6 +249,8 @@ class ServeEngine:
         registry=None,
         degraded_apply_fn=None,
         degrade_controller=None,
+        refined_apply_fn=None,
+        quality_controller=None,
         deadline_margin=1.0,
         hang_timeout=None,
         estimator=None,
@@ -294,12 +304,13 @@ class ServeEngine:
             estimator if estimator is not None else LatencyEstimator()
         )
 
-        # one jit wrapper per engine (two with a degraded program); the
+        # one jit wrapper per program variant (standard, plus degraded
+        # and/or refined when configured); the
         # jit caches are NEVER hit in steady state (serving calls the
         # AOT executables below) — they exist to lower/compile and to
         # count traces: the increment is a Python side effect that runs
-        # only when JAX actually retraces. Both wrappers share ONE
-        # counter, so `compile_count` covers dense + degraded programs.
+        # only when JAX actually retraces. ALL wrappers share ONE
+        # counter, so `compile_count` covers every variant's programs.
         self._trace_count = 0
 
         def _counted_apply(p, batch):
@@ -330,16 +341,39 @@ class ServeEngine:
             self._jit_degraded = jax.jit(
                 _counted_degraded, donate_argnums=SERVE_DONATE_ARGNUMS
             )
-        self.controller = (
-            degrade_controller
-            if degrade_controller is not None
-            else (
-                HysteresisController()
-                if degraded_apply_fn is not None
-                else None
+        self._jit_refined = None
+        if refined_apply_fn is not None:
+
+            def _counted_refined(p, batch):
+                self._trace_count += 1
+                return refined_apply_fn(p, batch)
+
+            self._jit_refined = jax.jit(
+                _counted_refined, donate_argnums=SERVE_DONATE_ARGNUMS
             )
-        )
-        self._compiled = {}  # (key, padded size, degraded, sharded) -> exe
+        # controller precedence: an injected quality_controller wins,
+        # then an injected degrade_controller; else a refined program
+        # auto-builds a QualityLadder over exactly the variants this
+        # engine can serve, and a degraded-only engine keeps the PR-8
+        # two-mode HysteresisController (both expose .degraded and
+        # .update(pressure); the ladder adds .variant, which
+        # `_variant_now` prefers when present)
+        if quality_controller is not None:
+            self.controller = quality_controller
+        elif degrade_controller is not None:
+            self.controller = degrade_controller
+        elif refined_apply_fn is not None:
+            rungs = (
+                ("refined", "standard", "degraded")
+                if degraded_apply_fn is not None
+                else ("refined", "standard")
+            )
+            self.controller = QualityLadder(rungs=rungs)
+        elif degraded_apply_fn is not None:
+            self.controller = HysteresisController()
+        else:
+            self.controller = None
+        self._compiled = {}  # (key, padded size, variant, sharded) -> exe
         self._compile_lock = threading.Lock()
         self._warm = False
         # every (key, per-sample spec) warmup has seen: the fleet re-warms
@@ -410,6 +444,10 @@ class ServeEngine:
             "serve_batches_degraded_total",
             "batches served by the degraded program",
         )
+        self._m_refined_batches = m.counter(
+            "serve_batches_refined_total",
+            "batches served by the refined (coarse-to-fine) program",
+        )
         self._m_sharded_batches = m.counter(
             "serve_batches_sharded_total",
             "batches served by the mesh-sharded (shard_map) program",
@@ -459,6 +497,14 @@ class ServeEngine:
             "serve_degraded_mode",
             "1 when dispatch is flipped to the degraded program",
         ).set_fn(lambda: 1.0 if self._degraded_now() else 0.0)
+        m.gauge(
+            "serve_quality_rung",
+            "current dispatch variant: 0 degraded, 1 standard, 2 refined",
+        ).set_fn(
+            lambda: {"degraded": 0.0, "standard": 1.0, "refined": 2.0}[
+                self._variant_now()
+            ]
+        )
         m.gauge(
             "serve_pressure",
             "queued-work fraction the degradation controller last saw",
@@ -538,22 +584,24 @@ class ServeEngine:
     def _program_params(self, sharded):
         return self._params_sharded if sharded else self._params
 
-    def _executable(self, key, bs, pspec, live, degraded=False,
+    def _executable(self, key, bs, pspec, live, variant="standard",
                     sharded=False):
-        ck = (key, bs, degraded, sharded)
+        ck = (key, bs, variant, sharded)
         exe = self._compiled.get(ck)
         if exe is not None:
             return exe
         if sharded:
             jit = self._jit_sharded
-        elif degraded:
-            jit = self._jit_degraded
         else:
-            jit = self._jit
+            jit = {
+                "standard": self._jit,
+                "degraded": self._jit_degraded,
+                "refined": self._jit_refined,
+            }[variant]
         if jit is None:
             raise ValueError(
-                "degraded dispatch requested but the engine has no "
-                "degraded_apply_fn"
+                f"{variant} dispatch requested but the engine has no "
+                f"{variant}_apply_fn"
             )
         with self._compile_lock:
             exe = self._compiled.get(ck)
@@ -572,13 +620,13 @@ class ServeEngine:
 
         ``bucket_specs``: iterable of ``(key, per-sample spec)`` where the
         spec is `payload_spec`-shaped (``{name: (shape, dtype)}``). Each
-        key is compiled at EVERY allowed padded batch size — and, when a
-        ``degraded_apply_fn`` is configured, in BOTH program variants —
-        so a warmed engine serves any traffic mix over those buckets with
-        zero compiles even across degradation flips. Incremental: may be
-        called again for newly-discovered buckets; warmup compiles are
-        never counted as recompiles. Returns the number of compiled
-        programs now cached.
+        key is compiled at EVERY allowed padded batch size — and in
+        EVERY configured program variant (standard, plus degraded and/or
+        refined) — so a warmed engine serves any traffic mix over those
+        buckets with zero compiles even across quality-ladder flips.
+        Incremental: may be called again for newly-discovered buckets;
+        warmup compiles are never counted as recompiles. Returns the
+        number of compiled programs now cached.
         """
         for key, pspec in bucket_specs:
             self.warmed_specs[key] = pspec
@@ -586,7 +634,11 @@ class ServeEngine:
                 self._executable(key, bs, pspec, live=False)
                 if self._jit_degraded is not None:
                     self._executable(
-                        key, bs, pspec, live=False, degraded=True
+                        key, bs, pspec, live=False, variant="degraded"
+                    )
+                if self._jit_refined is not None:
+                    self._executable(
+                        key, bs, pspec, live=False, variant="refined"
                     )
                 if self._shardable(bs):
                     self._executable(key, bs, pspec, live=False,
@@ -872,11 +924,12 @@ class ServeEngine:
             batch = MicroBatch(
                 batch.key, live, pad_size(len(live), self.batch_sizes)
             )
-        degraded = self._degraded_now()
-        # the sharded program is the LARGE-batch fast path; the degraded
-        # program is the overload fallback — under pressure the cheaper
-        # single-device band program wins
-        sharded = not degraded and self._shardable(batch.pad_to)
+        variant = self._variant_now()
+        # the sharded program is the LARGE-batch fast path for the
+        # STANDARD tier only; under pressure the cheaper single-device
+        # band program wins, and the refined tier ships as the
+        # single-device program it was warmed as
+        sharded = variant == "standard" and self._shardable(batch.pad_to)
         try:
             reqs = batch.requests
             names = sorted(reqs[0].payload)
@@ -890,7 +943,7 @@ class ServeEngine:
                 stacked[name] = np.stack(arrs)
             exe = self._executable(
                 batch.key, batch.pad_to, payload_spec(reqs[0].payload),
-                live=True, degraded=degraded, sharded=sharded,
+                live=True, variant=variant, sharded=sharded,
             )
             if sharded:
                 self._m_sharded_batches.inc()
@@ -906,27 +959,43 @@ class ServeEngine:
             return
         if self._dispatch_gen != gen:
             return  # superseded mid-call; the watchdog settled the batch
-        self._readout_q.put((batch, out, t_dispatch, degraded))
+        self._readout_q.put((batch, out, t_dispatch, variant))
 
-    # -- degradation controller ----------------------------------------
+    # -- quality/degradation controller --------------------------------
+
+    def _variant_now(self):
+        """The program variant dispatch uses RIGHT NOW. Clamps a rung the
+        engine cannot serve (controller says refined/degraded but no such
+        apply_fn was configured) to the standard program rather than
+        crash mid-dispatch."""
+        if self.controller is None:
+            return "standard"
+        variant = getattr(self.controller, "variant", None)
+        if variant is None:  # two-mode HysteresisController
+            variant = "degraded" if self.controller.degraded else "standard"
+        if variant == "degraded" and self._jit_degraded is None:
+            return "standard"
+        if variant == "refined" and self._jit_refined is None:
+            return "standard"
+        return variant
 
     def _degraded_now(self):
-        return (
-            self.controller is not None
-            and self._jit_degraded is not None
-            and self.controller.degraded
-        )
+        return self._variant_now() == "degraded"
 
     def _update_degrade(self):
-        if self.controller is None or self._jit_degraded is None:
+        if self.controller is None or (
+            self._jit_degraded is None and self._jit_refined is None
+        ):
             return
         pressure = (
             self._submit_q.qsize()
             + self._batcher.pending()
             + self._batch_q.qsize()
         ) / max(1, self._queue_limit)
-        was = self.controller.degraded
-        if self.controller.update(pressure) != was:
+        was = getattr(self.controller, "variant", self.controller.degraded)
+        self.controller.update(pressure)
+        now = getattr(self.controller, "variant", self.controller.degraded)
+        if now != was:
             self._m_flips.inc()
 
     # -- readout stage -------------------------------------------------
@@ -951,7 +1020,7 @@ class ServeEngine:
             item = self._readout_q.get()
             if item is _SENTINEL:
                 return
-            batch, out, t_dispatch, degraded = item
+            batch, out, t_dispatch, variant = item
             inflight["batch"] = batch
             # stage-level fault: delay:<s> models a slow D2H/convert
             # (the readout-deadline drill), crash escapes to the
@@ -973,8 +1042,10 @@ class ServeEngine:
                 self._m_batches.inc()
                 self._m_real.inc(n)
                 self._m_padded.inc(batch.pad_to)
-                if degraded:
+                if variant == "degraded":
                     self._m_degraded_batches.inc()
+                elif variant == "refined":
+                    self._m_refined_batches.inc()
                 self._m_batch_size.observe(n)
                 for i, r in enumerate(batch.requests):
                     if r.deadline is not None and now > r.deadline:
@@ -1169,8 +1240,10 @@ class ServeEngine:
             "sharded_batches": self._m_sharded_batches.value,
             "replica_down": self._m_replica_down.value,
             "degraded_batches": self._m_degraded_batches.value,
+            "refined_batches": self._m_refined_batches.value,
             "degrade_flips": self._m_flips.value,
             "degraded_mode": self._degraded_now(),
+            "quality_variant": self._variant_now(),
             "dispatch_hangs": self._m_hangs.value,
             "stage_restarts": {
                 "prep": self._m_prep_restarts.value,

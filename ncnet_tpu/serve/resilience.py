@@ -249,6 +249,92 @@ class HysteresisController:
         return self.degraded
 
 
+class QualityLadder:
+    """Queue-pressure -> quality-rung controller (multi-level hysteresis).
+
+    The generalization of `HysteresisController` from two modes to an
+    ordered ladder of program variants, richest first — e.g.
+    ``("refined", "standard", "degraded")`` (scripts/serve.py's default
+    with ``--refine``). The engine's dispatch thread calls
+    ``update(pressure)`` every loop iteration; sustained high pressure
+    climbs ONE rung toward cheaper per flip, sustained low pressure
+    steps back toward richer, and dead-band readings reset both streaks
+    — exactly the two-mode controller's discipline, applied per rung, so
+    a pressure spike cannot leap from refined straight to degraded and a
+    recovering queue re-earns each quality level one flip at a time.
+
+    Every rung must name a program family the engine actually warmed
+    ("standard" plus any of "refined"/"degraded"); the engine clamps an
+    unservable rung to "standard" rather than crash mid-dispatch.
+    """
+
+    def __init__(self, rungs=("refined", "standard", "degraded"),
+                 start="standard", high=0.75, low=0.25,
+                 up_count=2, down_count=4):
+        rungs = tuple(rungs)
+        if len(rungs) < 2:
+            raise ValueError(f"a ladder needs >= 2 rungs, got {rungs!r}")
+        if len(set(rungs)) != len(rungs):
+            raise ValueError(f"duplicate rungs: {rungs!r}")
+        if start not in rungs:
+            raise ValueError(f"start rung {start!r} not in {rungs!r}")
+        if not low < high:
+            raise ValueError(
+                f"hysteresis needs low < high, got low={low} high={high}"
+            )
+        if up_count < 1 or down_count < 1:
+            raise ValueError("up_count and down_count must be >= 1")
+        self.rungs = rungs
+        self.high = high
+        self.low = low
+        self.up_count = up_count
+        self.down_count = down_count
+        self.flips = 0
+        self.last_pressure = 0.0
+        self._above = 0
+        self._below = 0
+        self._i = rungs.index(start)
+
+    @property
+    def variant(self):
+        """The current rung's program-variant name."""
+        return self.rungs[self._i]
+
+    @property
+    def rung(self):
+        """Current position, 0 = richest."""
+        return self._i
+
+    @property
+    def degraded(self):
+        # NAMED-rung semantics, not position: a ("refined", "standard")
+        # ladder never reports degraded — its cheapest rung is the
+        # standard program, and metrics/report() must say so.
+        return self.variant == "degraded"
+
+    def update(self, pressure):
+        p = float(pressure)
+        self.last_pressure = p
+        if p >= self.high:
+            self._above += 1
+            self._below = 0
+        elif p <= self.low:
+            self._below += 1
+            self._above = 0
+        else:
+            self._above = 0
+            self._below = 0
+        if self._above >= self.up_count and self._i < len(self.rungs) - 1:
+            self._i += 1
+            self.flips += 1
+            self._above = 0
+        elif self._below >= self.down_count and self._i > 0:
+            self._i -= 1
+            self.flips += 1
+            self._below = 0
+        return self.variant
+
+
 # ----------------------------------------------------------------------
 # supervision: restart-on-crash stage loops + the dispatch watchdog
 

@@ -127,7 +127,29 @@ def weak_loss_core(nc_params, config, feat_a, feat_b, normalization="softmax"):
         )
     feat_a_neg = jnp.roll(feat_a, -1, axis=0)
 
-    if getattr(config, "nc_topk", 0):
+    if getattr(config, "refine_factor", 0):
+        # coarse-to-fine path (ncnet_tpu.refine, takes precedence over
+        # nc_topk exactly like match_pipeline): the coarse band runs on
+        # pooled features and the refined FINE-grid band is scored with
+        # the same band scorer the sparse path uses — at refine_factor=1
+        # the two branches produce bitwise-identical losses.
+        from ncnet_tpu.refine.pipeline import refine_match_pipeline
+
+        def _refine_score(fa, fb):
+            values_f, indices_f, grid_f = refine_match_pipeline(
+                nc_params, config, fa, fb
+            )
+            return band_match_score_per_sample(
+                values_f, indices_f, grid_f, normalization
+            )
+
+        def pair_scores(fa, fb, fan):
+            return (
+                sanitizer.tap("score_pos", _refine_score(fa, fb)),
+                sanitizer.tap("score_neg", _refine_score(fan, fb)),
+            )
+
+    elif getattr(config, "nc_topk", 0):
         # sparse-band path (ncnet_tpu.sparse): positives AND negatives are
         # scored on each pair's own top-K band — the NC stack never sees
         # the dense correlation. The chunking/remat machinery below wraps

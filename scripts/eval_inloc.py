@@ -81,6 +81,19 @@ def main():
                         "with --spatial_shards/--device_preprocess/"
                         "--device_resize (the store path has its own "
                         "host pipeline)")
+    p.add_argument("--refine", type=int, default=None, metavar="R",
+                   help="coarse-to-fine refinement (ncnet_tpu.refine): "
+                        "pool features by R, run the coarse band at "
+                        "--refine_topk, re-score the survivors at high "
+                        "res. Requires --k_size 1 (refinement replaces "
+                        "the 4D-maxpool relocalization — both are "
+                        "memory ladders, refinement reads out at the "
+                        "full grid). 0 forces refinement OFF; unset "
+                        "keeps the checkpoint's value")
+    p.add_argument("--refine_topk", type=int, default=None, metavar="K",
+                   help="with --refine: coarse-band width")
+    p.add_argument("--refine_radius", type=int, default=None,
+                   help="with --refine: extra window reach in coarse cells")
     p.add_argument("--spatial_shards", type=int, default=0,
                    help="shard the correlation pipeline over this many "
                         "devices ('spatial' mesh axis) for grids beyond "
@@ -122,6 +135,19 @@ def main():
         relocalization_k_size=args.k_size,
         conv4d_impl=args.conv4d_impl,
     )
+    if args.refine is not None:
+        config = config.replace(refine_factor=args.refine)
+    if args.refine_topk is not None:
+        config = config.replace(refine_topk=args.refine_topk)
+    if args.refine_radius is not None:
+        config = config.replace(refine_radius=args.refine_radius)
+    if config.refine_factor and args.k_size > 1:
+        # refine_match_pipeline raises on relocalization configs deep in
+        # the first trace; fail at the flag boundary instead
+        p.error(
+            f"--refine {config.refine_factor} requires --k_size 1 "
+            "(refinement replaces the 4D-maxpool relocalization)"
+        )
 
     exp = os.path.basename(args.inloc_shortlist).split(".")[0]
     exp += f"_SZ_NEW_{args.image_size}_K_{args.k_size}"

@@ -29,6 +29,17 @@ def main():
                         "eval forward (readout stays f32). Default: the "
                         "checkpoint's recorded dtype; --bf16 / --no-bf16 "
                         "override in either direction")
+    p.add_argument("--refine", type=int, default=None, metavar="R",
+                   help="coarse-to-fine refinement (ncnet_tpu.refine) for "
+                        "the eval forward: pool features by R, run the "
+                        "coarse band at --refine_topk, re-score the "
+                        "surviving neighbourhoods at high res. 0 forces "
+                        "refinement OFF; unset keeps the checkpoint's "
+                        "recorded value")
+    p.add_argument("--refine_topk", type=int, default=None, metavar="K",
+                   help="with --refine: coarse-band width")
+    p.add_argument("--refine_radius", type=int, default=None,
+                   help="with --refine: extra window reach in coarse cells")
     p.add_argument("--conv4d_impl", type=str, default="tlc",
                    help="conv4d lowering for the eval forward (overrides "
                         "the checkpoint's training-tuned mix, whose "
@@ -58,6 +69,20 @@ def main():
         config = config.replace(conv4d_impl=args.conv4d_impl)
     if args.bf16 is not None:
         config = config.replace(half_precision=args.bf16)
+    if args.refine is not None:
+        config = config.replace(refine_factor=args.refine)
+    if args.refine_topk is not None:
+        config = config.replace(refine_topk=args.refine_topk)
+    if args.refine_radius is not None:
+        config = config.replace(refine_radius=args.refine_radius)
+    if config.refine_factor:
+        grid = max(args.image_size // 16, 1)
+        if grid % config.refine_factor:
+            p.error(
+                f"--image_size {args.image_size} gives a {grid}x{grid} "
+                f"feature grid, which does not divide by --refine "
+                f"{config.refine_factor}"
+            )
 
     dataset = PFPascalDataset(
         os.path.join(args.eval_dataset_path, "image_pairs", "test_pairs.csv"),

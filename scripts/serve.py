@@ -37,6 +37,14 @@ SLOs & resilience (ncnet_tpu.serve.resilience):
                            the hysteresis controller flip dispatch to it
                            under sustained queue pressure (back when it
                            clears); flips + degraded batches reported
+  --refine R               pre-warm a THIRD program family per bucket: the
+                           coarse-to-fine refined forward (ncnet_tpu.refine)
+                           at pool factor R — the quality ladder's top
+                           rung. With --degrade the ladder walks
+                           refined <-> standard <-> degraded on queue
+                           pressure; without it, refined <-> standard.
+                           Every rung is AOT-warmed, so a tier flip never
+                           compiles: quality itself becomes the SLO knob
   --hang-timeout S         dispatch heartbeat watchdog (must exceed the
                            worst-case batch latency incl. live compiles)
   --drain-timeout S        SIGTERM stops admission and drains under this
@@ -169,6 +177,23 @@ def parse_args(argv=None):
     p.add_argument("--degrade-low", type=float, default=0.25,
                    help="queue-pressure fraction that flips back "
                         "(hysteresis low water)")
+    p.add_argument("--refine", type=int, default=0, metavar="R",
+                   help="pre-warm the coarse-to-fine REFINED program "
+                        "(ncnet_tpu.refine) at pool factor R as the "
+                        "quality ladder's top rung; dispatch walks down "
+                        "to standard (and --degrade, when set) under "
+                        "sustained queue pressure and back up when it "
+                        "clears — zero recompiles across tier flips "
+                        "(0 disables; the feature grid image_size/16 "
+                        "must divide by R)")
+    p.add_argument("--refine-topk", type=int, default=16,
+                   dest="refine_topk", metavar="K",
+                   help="with --refine: coarse-band width (survivor "
+                        "count re-scored at high res)")
+    p.add_argument("--refine-radius", type=int, default=0,
+                   dest="refine_radius",
+                   help="with --refine: extra window reach in coarse "
+                        "cells around each survivor")
     p.add_argument("--hang-timeout", type=float, default=30.0,
                    help="dispatch heartbeat watchdog seconds (0 "
                         "disables); must exceed the worst-case batch "
@@ -285,6 +310,7 @@ def _run(args, telemetry):
         BucketSpec,
         DeadlineExceeded,
         HysteresisController,
+        QualityLadder,
         ReplicaDown,
         RequestShed,
         ServeEngine,
@@ -358,11 +384,18 @@ def _run(args, telemetry):
                 "source_image": src, "target_image": tgt,
             }
 
+    if getattr(config, "refine_factor", 0):
+        # serving treats refinement as a dispatch TIER, not a baked-in
+        # config: the standard program strips it, --refine rebuilds it
+        # as the ladder's top rung
+        config = config.replace(refine_factor=0)
     apply_fn = make_serve_match_step(
         config, from_features=bool(args.feature_store)
     )
     degraded_apply_fn = None
+    refined_apply_fn = None
     controller = None
+    quality_controller = None
     if args.degrade >= 0:
         # the overload fallback: the SAME serving forward at a sparse
         # nc_topk band (arXiv:2004.10566 reproduction, PR 4) — ~3x
@@ -372,6 +405,34 @@ def _run(args, telemetry):
             config.replace(nc_topk=args.degrade),
             from_features=bool(args.feature_store),
         )
+    if args.refine > 0:
+        grid = max(args.image_size // 16, 1)
+        if grid % args.refine:
+            raise SystemExit(
+                f"--refine {args.refine}: the {grid}x{grid} feature grid "
+                f"at --image-size {args.image_size} does not divide by "
+                f"the pool factor (each bucket's grid must divide)"
+            )
+        # the quality ceiling: coarse band at --refine-topk on pooled
+        # features, gather-only re-score of the survivors at high res
+        # (ncnet_tpu.refine, same no-scatter discipline as the band) —
+        # pre-warmed per (bucket, batch-size) alongside the others
+        refined_apply_fn = make_serve_match_step(
+            config.replace(
+                refine_factor=args.refine,
+                refine_topk=args.refine_topk,
+                refine_radius=args.refine_radius,
+            ),
+            from_features=bool(args.feature_store),
+        )
+    if args.refine > 0:
+        quality_controller = QualityLadder(
+            rungs=(("refined", "standard", "degraded")
+                   if degraded_apply_fn is not None
+                   else ("refined", "standard")),
+            high=args.degrade_high, low=args.degrade_low,
+        )
+    elif args.degrade >= 0:
         controller = HysteresisController(
             high=args.degrade_high, low=args.degrade_low
         )
@@ -387,6 +448,7 @@ def _run(args, telemetry):
         "feature_store": bool(args.feature_store),
         "deadline_ms": args.deadline_ms,
         "degrade_topk": args.degrade,
+        "refine_factor": args.refine,
     }
 
     if args.sequential:
@@ -442,11 +504,12 @@ def _run(args, telemetry):
             prep_fn=prep,
             prep_retries=args.prep_retries,
             degraded_apply_fn=degraded_apply_fn,
+            refined_apply_fn=refined_apply_fn,
         )
         if args.fleet:
             # per-replica engines keep PRIVATE registries (and, with
-            # --degrade, private default-threshold controllers — one
-            # shared mutable controller would race across dispatch
+            # --degrade/--refine, private default-threshold controllers
+            # — one shared mutable controller would race across dispatch
             # threads); the session snapshots each with a {replica=R}
             # tag, the fleet's own counters land in the default registry
             server = ServeFleet(
@@ -470,6 +533,7 @@ def _run(args, telemetry):
                 registry=(telemetry.default_registry() if args.telemetry
                           else None),
                 degrade_controller=controller,
+                quality_controller=quality_controller,
                 hang_timeout=hang,
                 shard_mesh=shard_mesh,
                 shard_min_batch=args.shard_batch,

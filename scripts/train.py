@@ -204,6 +204,21 @@ def main():
                         "(swap-closed up to capacity, better B-grid "
                         "coverage; the default) vs plain per-A top-K "
                         "(--no-nc_topk_mutual)")
+    p.add_argument("--refine", type=int, default=None, metavar="R",
+                   help="coarse-to-fine refinement (ncnet_tpu.refine): "
+                        "pool features by R, run the sparse band at the "
+                        "coarse grid (width --refine_topk), then re-score "
+                        "only the surviving neighbourhoods against the "
+                        "high-res features. 0 = off; takes precedence "
+                        "over --nc_topk. Unset keeps a resumed "
+                        "checkpoint's recorded value")
+    p.add_argument("--refine_topk", type=int, default=None, metavar="K",
+                   help="with --refine: coarse-band width (default 16; "
+                        "unset keeps a resumed checkpoint's value)")
+    p.add_argument("--refine_radius", type=int, default=None,
+                   help="with --refine: extra window reach in coarse "
+                        "cells around each surviving candidate "
+                        "(default 0 — the R x R block under it)")
     p.add_argument("--loss_chunk", type=int, default=None,
                    help="run the correlation->NC->score loss over sample "
                         "chunks of this size (0 = whole batch; when "
@@ -328,6 +343,10 @@ def main():
             nc_topk=args.nc_topk or 0,
             nc_topk_mutual=(True if args.nc_topk_mutual is None
                             else args.nc_topk_mutual),
+            refine_factor=args.refine or 0,
+            refine_topk=(16 if args.refine_topk is None
+                         else args.refine_topk),
+            refine_radius=args.refine_radius or 0,
         )
         print(f"initialized from reference checkpoint {args.checkpoint} "
               "(weights-only: torch optimizer state is not portable)")
@@ -363,6 +382,13 @@ def main():
             config = config.replace(nc_topk=args.nc_topk)
         if args.nc_topk_mutual is not None:
             config = config.replace(nc_topk_mutual=args.nc_topk_mutual)
+        if args.refine is not None:  # coarse-to-fine: override in either
+            # direction; unset keeps the checkpoint's recorded value
+            config = config.replace(refine_factor=args.refine)
+        if args.refine_topk is not None:
+            config = config.replace(refine_topk=args.refine_topk)
+        if args.refine_radius is not None:
+            config = config.replace(refine_radius=args.refine_radius)
         if args.bf16 is not None:  # explicit flag overrides the
             # checkpoint's compute dtype in either direction (master
             # params are f32 in both modes, so the weights are portable)
@@ -425,8 +451,23 @@ def main():
             nc_topk=args.nc_topk or 0,
             nc_topk_mutual=(True if args.nc_topk_mutual is None
                             else args.nc_topk_mutual),
+            refine_factor=args.refine or 0,
+            refine_topk=(16 if args.refine_topk is None
+                         else args.refine_topk),
+            refine_radius=args.refine_radius or 0,
         )
         params = init_immatchnet(jax.random.PRNGKey(args.seed), config)
+
+    # validate the EFFECTIVE refine geometry (wherever the config came
+    # from) against the feature grid: the pool needs an even division
+    if config.refine_factor:
+        grid = max(args.image_size // 16, 1)
+        if grid % config.refine_factor:
+            p.error(
+                f"--image_size {args.image_size} gives a {grid}x{grid} "
+                f"feature grid, which does not divide by --refine "
+                f"{config.refine_factor}"
+            )
 
     # validate the EFFECTIVE chunking (wherever the config came from)
     # against the batch: weak_loss treats chunk >= batch as unchunked, so

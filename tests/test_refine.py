@@ -1,0 +1,584 @@
+"""Coarse-to-fine refinement (ncnet_tpu.refine) + its serving tier.
+
+The design contract under test: with ``refine_factor == 1`` and
+``refine_radius == 0`` the pool is an identity and every re-scoring
+window holds exactly its own candidate, so the refined band must equal
+the plain sparse band BITWISE in eager mode — and chained with the
+band's own ``K = hB*wB`` completeness contract (tests/test_sparse.py)
+the whole ladder reduces to the dense pipeline. That anchor is what the
+genuinely multi-resolution cases (factor 2 geometry, jit parity, the
+padding independence, the served quality-ladder flip at zero recompiles,
+and the analytic FLOP ledger) ride on.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.models.immatchnet import (
+    ImMatchNetConfig,
+    init_immatchnet,
+    match_pipeline,
+)
+from ncnet_tpu.refine import (
+    check_refine_config,
+    pool_features,
+    refine_match_pipeline,
+    refine_rescore,
+    refine_window_indices,
+)
+from ncnet_tpu.sparse.pipeline import sparse_match_pipeline
+
+BASE = dict(ncons_kernel_sizes=(3, 3), ncons_channels=(4, 1))
+#: the band's bitwise dense anchor (tests/test_sparse.py): conv lowering
+#: + bias placement mirror the band GEMMs term-for-term
+DENSE_MIRROR = ImMatchNetConfig(
+    conv4d_impl="gemm4/gemm4", symmetric_batch=False, **BASE
+)
+
+
+def _feats(rng, b, h, w, c=7):
+    return (
+        jnp.asarray(rng.randn(b, h, w, c).astype(np.float32)),
+        jnp.asarray(rng.randn(b, h, w, c).astype(np.float32)),
+    )
+
+
+# --- pooling -----------------------------------------------------------------
+
+
+def test_pool_factor1_is_identity_object():
+    """factor 1 must return the INPUT, not a renormalized copy — the
+    r==1 rung is the bitwise exactness anchor, and re-dividing by a
+    computed ~1.0 norm would perturb the last bit."""
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 4, 4, 3), jnp.float32)
+    assert pool_features(x, 1) is x
+
+
+def test_pool_factor2_mean_then_renorm():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 6, 5).astype(np.float32)
+    got = np.asarray(pool_features(jnp.asarray(x), 2))
+    assert got.shape == (2, 2, 3, 5)
+    want = x.reshape(2, 2, 2, 3, 2, 5).mean(axis=(2, 4))
+    want /= np.sqrt((want**2).sum(-1, keepdims=True))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        (got**2).sum(-1), np.ones((2, 2, 3)), rtol=1e-5
+    )
+    raw = np.asarray(pool_features(jnp.asarray(x), 2, normalize=False))
+    np.testing.assert_allclose(
+        raw, x.reshape(2, 2, 2, 3, 2, 5).mean(axis=(2, 4)), rtol=1e-6
+    )
+
+
+def test_pool_rejects_nondividing_grid():
+    x = jnp.zeros((1, 5, 4, 3), jnp.float32)
+    with pytest.raises(ValueError, match="does not divide"):
+        pool_features(x, 2)
+    with pytest.raises(ValueError, match=">= 1"):
+        pool_features(x, 0)
+
+
+# --- window pointer table ----------------------------------------------------
+
+
+def test_refine_window_indices_numpy_golden():
+    """factor 2, radius 1 on a 2x3 coarse grid: every pointer checked
+    against the brute-force fine-cell enumeration, off-grid slots must
+    hold the null index (fine-grid size) with valid=False."""
+    h_lo, w_lo, r, radius = 2, 3, 2, 1
+    h_hi, w_hi = h_lo * r, w_lo * r
+    rng = np.random.RandomState(2)
+    idx = rng.randint(0, h_lo * w_lo, size=(1, 2, 2, 3)).astype(np.int32)
+    widx, valid = refine_window_indices(
+        jnp.asarray(idx), (h_lo, w_lo), (h_hi, w_hi), r, radius
+    )
+    side = r * (2 * radius + 1)
+    assert widx.shape == (1, 2, 2, 3, side * side)
+    widx, valid = np.asarray(widx), np.asarray(valid)
+    null = h_hi * w_hi
+    for a1 in range(2):
+        for a2 in range(2):
+            for k in range(3):
+                pi, pj = divmod(int(idx[0, a1, a2, k]), w_lo)
+                for u in range(side):
+                    for v in range(side):
+                        fi = pi * r + u - radius * r
+                        fj = pj * r + v - radius * r
+                        t = u * side + v
+                        on = 0 <= fi < h_hi and 0 <= fj < w_hi
+                        assert valid[0, a1, a2, k, t] == on
+                        want = fi * w_hi + fj if on else null
+                        assert widx[0, a1, a2, k, t] == want
+
+
+def test_refine_window_indices_rejects_grid_mismatch():
+    with pytest.raises(ValueError, match="not the coarse grid"):
+        refine_window_indices(
+            jnp.zeros((1, 2, 2, 1), jnp.int32), (2, 2), (5, 4), 2
+        )
+
+
+# --- the exactness contract --------------------------------------------------
+
+
+def test_refined_equals_band_bitwise_eager():
+    """factor 1 + radius 0: single-entry windows, softmax gain exactly
+    1.0 — refined values AND indices bitwise the plain band's."""
+    rng = np.random.RandomState(3)
+    fa, fb = _feats(rng, 2, 4, 4)
+    cfg = ImMatchNetConfig(**BASE)
+    params = init_immatchnet(jax.random.PRNGKey(3), cfg)
+    nc = params["neigh_consensus"]
+    k = 5
+    vb, ib, gb = sparse_match_pipeline(
+        nc, cfg.replace(nc_topk=k), fa, fb
+    )
+    vr, ir, gr = refine_match_pipeline(
+        nc, cfg.replace(refine_factor=1, refine_topk=k), fa, fb
+    )
+    assert gr == gb
+    np.testing.assert_array_equal(np.asarray(ir), np.asarray(ib))
+    np.testing.assert_array_equal(np.asarray(vr), np.asarray(vb))
+
+
+def test_refined_full_k_matches_dense_bitwise_eager():
+    """The chained anchor: factor 1 at the COMPLETE band width reduces
+    the whole coarse-to-fine ladder to the dense pipeline, bitwise
+    against the gemm-mirror dense lowering."""
+    rng = np.random.RandomState(4)
+    fa, fb = _feats(rng, 2, 4, 4)
+    params = init_immatchnet(jax.random.PRNGKey(4), DENSE_MIRROR)
+    nc = params["neigh_consensus"]
+    out_d = np.asarray(match_pipeline(nc, DENSE_MIRROR, fa, fb))
+    out_r = np.asarray(
+        match_pipeline(
+            nc,
+            DENSE_MIRROR.replace(refine_factor=1, refine_topk=16),
+            fa, fb,
+        )
+    )
+    np.testing.assert_array_equal(out_r, out_d)
+
+
+def test_refined_jit_matches_eager():
+    rng = np.random.RandomState(5)
+    fa, fb = _feats(rng, 2, 4, 4)
+    cfg = ImMatchNetConfig(
+        refine_factor=2, refine_topk=3, refine_radius=1, **BASE
+    )
+    params = init_immatchnet(jax.random.PRNGKey(5), cfg)
+    nc = params["neigh_consensus"]
+    ve, ie, ge = refine_match_pipeline(nc, cfg, fa, fb)
+    vj, ij, gj = jax.jit(
+        lambda p, a, b: refine_match_pipeline(p, cfg, a, b)
+    )(nc, fa, fb)
+    assert gj == ge
+    np.testing.assert_array_equal(np.asarray(ij), np.asarray(ie))
+    np.testing.assert_allclose(
+        np.asarray(vj), np.asarray(ve), rtol=1e-6, atol=1e-7
+    )
+
+
+def test_refined_factor2_geometry_and_window_containment():
+    """factor 2 on a 6x6 fine grid: the refined band lives on the FINE
+    grids, every relocated index is on-grid, and each one lies inside
+    its own coarse candidate's window (the gather can only choose among
+    the cells the pointer table enumerates)."""
+    rng = np.random.RandomState(6)
+    fa, fb = _feats(rng, 1, 6, 6)
+    cfg = ImMatchNetConfig(refine_factor=2, refine_topk=4, **BASE)
+    params = init_immatchnet(jax.random.PRNGKey(6), cfg)
+    nc = params["neigh_consensus"]
+    # the coarse band the refinement consumed, recomputed for reference
+    cv, ci, (h_lo, w_lo) = sparse_match_pipeline(
+        nc, cfg.replace(refine_factor=0, nc_topk=4),
+        pool_features(fa, 2), pool_features(fb, 2),
+    )
+    vals, idx, (h_hi, w_hi) = refine_rescore(cv, ci, (h_lo, w_lo), fa, fb, 2)
+    assert (h_hi, w_hi) == (6, 6)
+    assert vals.shape == idx.shape == (1, 6, 6, 4)
+    idx, ci = np.asarray(idx), np.asarray(ci)
+    assert idx.min() >= 0 and idx.max() < h_hi * w_hi
+    for ai in range(6):
+        for aj in range(6):
+            for k in range(4):
+                pi, pj = divmod(int(ci[0, ai // 2, aj // 2, k]), w_lo)
+                fi, fj = divmod(int(idx[0, ai, aj, k]), w_hi)
+                assert pi * 2 <= fi < (pi + 1) * 2
+                assert pj * 2 <= fj < (pj + 1) * 2
+
+
+def test_batch_rows_independent_of_batchmates():
+    """The padding contract's function-level core: a pair's refined band
+    does not depend on what else rides in the batch (the serve engine
+    pads batches with row duplicates)."""
+    rng = np.random.RandomState(7)
+    fa, fb = _feats(rng, 2, 4, 4)
+    cfg = ImMatchNetConfig(refine_factor=2, refine_topk=3, **BASE)
+    params = init_immatchnet(jax.random.PRNGKey(7), cfg)
+    nc = params["neigh_consensus"]
+    v2, i2, _ = refine_match_pipeline(nc, cfg, fa, fb)
+    v1, i1, _ = refine_match_pipeline(nc, cfg, fa[:1], fb[:1])
+    np.testing.assert_array_equal(np.asarray(i2)[:1], np.asarray(i1))
+    np.testing.assert_allclose(
+        np.asarray(v2)[:1], np.asarray(v1), rtol=1e-6, atol=1e-7
+    )
+
+
+# --- config plumbing ---------------------------------------------------------
+
+
+def test_check_refine_config_validation():
+    check_refine_config(ImMatchNetConfig(refine_factor=0))
+    check_refine_config(ImMatchNetConfig(refine_factor=2, refine_topk=8))
+    with pytest.raises(ValueError, match="negative"):
+        check_refine_config(ImMatchNetConfig(refine_factor=-1))
+    with pytest.raises(ValueError, match="band width"):
+        check_refine_config(
+            ImMatchNetConfig(refine_factor=2, refine_topk=0)
+        )
+    with pytest.raises(ValueError, match="negative"):
+        check_refine_config(
+            ImMatchNetConfig(refine_factor=2, refine_radius=-1)
+        )
+    with pytest.raises(ValueError, match="relocalization"):
+        check_refine_config(
+            ImMatchNetConfig(refine_factor=2, relocalization_k_size=2)
+        )
+
+
+def test_config_roundtrip_and_legacy_dicts():
+    cfg = ImMatchNetConfig(refine_factor=4, refine_topk=8, refine_radius=1)
+    again = ImMatchNetConfig.from_dict(cfg.to_dict())
+    assert (again.refine_factor, again.refine_topk, again.refine_radius) \
+        == (4, 8, 1)
+    # checkpoints written before the refine path have no refine keys
+    legacy = cfg.to_dict()
+    for key in ("refine_factor", "refine_topk", "refine_radius"):
+        del legacy[key]
+    old = ImMatchNetConfig.from_dict(legacy)
+    assert (old.refine_factor, old.refine_topk, old.refine_radius) \
+        == (0, 16, 0)
+
+
+# --- the quality ladder ------------------------------------------------------
+
+
+def test_quality_ladder_walks_one_rung_per_flip():
+    from ncnet_tpu.serve.resilience import QualityLadder
+
+    lad = QualityLadder(up_count=2, down_count=2)
+    assert lad.variant == "standard" and not lad.degraded
+    # sustained pressure climbs ONE rung toward cheaper per flip
+    lad.update(0.9)
+    assert lad.update(0.9) == "degraded" and lad.flips == 1
+    assert lad.degraded
+    # a recovering queue re-earns each level one flip at a time
+    lad.update(0.1)
+    assert lad.update(0.1) == "standard" and lad.flips == 2
+    lad.update(0.1)
+    assert lad.update(0.1) == "refined" and lad.flips == 3
+    assert not lad.degraded  # 'refined' is a NAMED rung, not a mode bit
+    # dead-band readings reset both streaks
+    lad2 = QualityLadder(up_count=2, down_count=2)
+    lad2.update(0.9)
+    lad2.update(0.5)
+    lad2.update(0.9)
+    assert lad2.variant == "standard" and lad2.flips == 0
+
+
+def test_quality_ladder_validation():
+    from ncnet_tpu.serve.resilience import QualityLadder
+
+    with pytest.raises(ValueError, match=">= 2 rungs"):
+        QualityLadder(rungs=("standard",))
+    with pytest.raises(ValueError, match="duplicate"):
+        QualityLadder(rungs=("standard", "standard"))
+    with pytest.raises(ValueError, match="start rung"):
+        QualityLadder(rungs=("refined", "standard"), start="degraded")
+    two = QualityLadder(rungs=("refined", "standard"), start="standard")
+    assert not two.degraded  # this ladder has no degraded rung to report
+
+
+def test_serve_refined_tier_flip_zero_recompiles():
+    """The served quality ladder: three program families pre-warmed per
+    (bucket, batch size); pinning the controller to each rung dispatches
+    that rung's program (results prove which one ran) with ZERO traces
+    after warmup — a tier flip never compiles. The controller is pinned
+    because the engine's dispatch thread calls update() on every loop
+    iteration with live queue pressure, racing any scripted sequence."""
+    from ncnet_tpu.serve import ServeEngine, payload_spec
+    from ncnet_tpu.serve.resilience import QualityLadder
+
+    params = {"w": jnp.asarray(3.0, jnp.float32)}
+
+    class Pinned(QualityLadder):
+        def update(self, pressure):
+            self.last_pressure = float(pressure)
+            return self.variant
+
+        def pin(self, variant):
+            self._i = self.rungs.index(variant)
+
+    lad = Pinned()
+
+    def mk(mult):
+        def apply(p, batch):
+            return {"y": batch["x"] * p["w"] * mult}
+        return apply
+
+    with ServeEngine(
+        mk(1.0), params,
+        max_batch=2, max_wait=0.005, batch_sizes=(1, 2),
+        degraded_apply_fn=mk(-1.0),
+        refined_apply_fn=mk(10.0),
+        quality_controller=lad,
+    ) as eng:
+        eng.warmup(
+            [("A", payload_spec({"x": np.ones((3,), np.float32)}))]
+        )
+        warm_traces = eng.compile_count
+        for variant, mult in (
+            ("standard", 3.0), ("refined", 30.0), ("degraded", -3.0),
+            ("refined", 30.0),
+        ):
+            lad.pin(variant)
+            fut = eng.submit(
+                key="A", payload={"x": np.full((3,), 2.0, np.float32)}
+            )
+            np.testing.assert_array_equal(
+                fut.result(timeout=60)["y"],
+                np.full((3,), 2.0 * mult, np.float32),
+            )
+        stats = eng.report()
+    assert eng.compile_count == warm_traces  # nothing retraced on flips
+    assert stats["recompiles_after_warmup"] == 0
+    assert stats["refined_batches"] >= 2
+    assert stats["degraded_batches"] >= 1
+    assert stats["quality_variant"] == "refined"
+
+
+# --- analytic FLOP ledger ----------------------------------------------------
+
+
+def test_refine_flop_closed_forms():
+    from ncnet_tpu.ops.accounting import (
+        refine_match_flops,
+        refine_rescore_flops,
+        refine_window,
+        train_step_flops_for_batch,
+    )
+
+    assert refine_window(2) == 4
+    assert refine_window(2, radius=1) == 36
+    assert refine_rescore_flops(
+        batch=1, grid_hi=4, nc_topk=3, window=4, feat_ch=8
+    ) == 2.0 * 16 * 3 * 4 * 8
+    # K clamps to the coarse grid's nB: factor 2 on grid 4 -> nB_lo = 4
+    clamped = refine_match_flops(
+        1, (3,), (1,), grid_hi=4, factor=2, nc_topk=999, feat_ch=8,
+        from_features=True,
+    )
+    exact = refine_match_flops(
+        1, (3,), (1,), grid_hi=4, factor=2, nc_topk=4, feat_ch=8,
+        from_features=True,
+    )
+    assert clamped == exact
+    with pytest.raises(ValueError, match="divide"):
+        refine_match_flops(
+            1, (3,), (1,), grid_hi=5, factor=2, nc_topk=4, feat_ch=8
+        )
+    # the train-step dispatcher routes refined configs to the refine form
+    cfg = ImMatchNetConfig(
+        feature_extraction_cnn="patch16", ncons_kernel_sizes=(3,),
+        ncons_channels=(1,), refine_factor=2, refine_topk=4,
+    )
+    refined = train_step_flops_for_batch(
+        cfg, batch={"source_image": np.zeros((2, 64, 64, 3))},
+        from_features=False,
+    )
+    dense = train_step_flops_for_batch(
+        cfg.replace(refine_factor=0),
+        batch={"source_image": np.zeros((2, 64, 64, 3))},
+        from_features=False,
+    )
+    assert refined != dense and refined > 0
+
+
+def test_refine_audit_programs_clean_and_walk_exact():
+    """The auditor's FLOP walk over the REAL refined programs agrees
+    with the closed form to round-off — the MFU-numerator tripwire for
+    the refine path (same gate scripts/audit.py runs in CI)."""
+    from ncnet_tpu.analysis.jaxpr_audit import audit
+
+    result = audit(["train/refine", "refine/rescore"])
+    assert result.all_findings == [], [
+        f.format() for f in result.all_findings
+    ]
+    for r in result.reports:
+        assert r["flops_expected"], r
+        drift = (
+            abs(r["flops_walked"] - r["flops_expected"])
+            / r["flops_expected"]
+        )
+        assert drift < 1e-9, (r["program"], r["flops_walked"])
+
+
+# --- multi-resolution feature store ------------------------------------------
+
+
+def test_pooled_digest_binds_base_and_factor():
+    from ncnet_tpu.features import pooled_digest
+
+    d = pooled_digest("a" * 64, 2)
+    assert d == pooled_digest("a" * 64, 2)  # deterministic
+    assert d != pooled_digest("a" * 64, 4)  # factor-sensitive
+    assert d != pooled_digest("b" * 64, 2)  # base-sensitive
+    assert d != "a" * 64
+    with pytest.raises(ValueError, match=">= 1"):
+        pooled_digest("a" * 64, 0)
+
+
+def test_multires_store_roundtrip_and_torn_pair(tmp_path):
+    from ncnet_tpu.features import MultiResFeatureStore
+
+    cfg = ImMatchNetConfig(ncons_kernel_sizes=(3,), ncons_channels=(1,))
+    store = MultiResFeatureStore.open_or_create(
+        str(tmp_path / "mr"), "c" * 64, cfg, (64, 64), 2, factor=2
+    )
+    rng = np.random.RandomState(8)
+    hi = [rng.randn(4, 4, 3).astype(np.float32) for _ in range(2)]
+    lo = [rng.randn(2, 2, 3).astype(np.float32) for _ in range(2)]
+    store.put(0, hi[0], hi[1], lo[0], lo[1])
+    (ghs, ght), (gls, glt) = store.get(0)
+    np.testing.assert_array_equal(np.asarray(ghs), hi[0])
+    np.testing.assert_array_equal(np.asarray(ght), hi[1])
+    np.testing.assert_array_equal(np.asarray(gls), lo[0])
+    np.testing.assert_array_equal(np.asarray(glt), lo[1])
+    # a pair with only ONE tier written is still missing: a crash
+    # between the two writes re-extracts instead of serving a torn
+    # resolution ladder
+    store.hi.put(1, hi[0], hi[1])
+    assert not store.has(1)
+    assert store.missing() == [1] and not store.complete()
+    store.lo.put(1, lo[0], lo[1])
+    assert store.complete()
+
+
+def test_multires_store_stale_tiers_rejected(tmp_path):
+    from ncnet_tpu.features import (
+        FeatureCacheMismatch,
+        FeatureStore,
+        MultiResFeatureStore,
+        pooled_digest,
+    )
+
+    cfg = ImMatchNetConfig(ncons_kernel_sizes=(3,), ncons_channels=(1,))
+    root = str(tmp_path / "mr")
+    MultiResFeatureStore.open_or_create(
+        root, "c" * 64, cfg, (64, 64), 1, factor=2
+    )
+    # a different trunk digest refuses BOTH on open and on open_or_create
+    with pytest.raises(FeatureCacheMismatch):
+        MultiResFeatureStore.open_store(root, 2, expected_digest="d" * 64)
+    with pytest.raises(FeatureCacheMismatch):
+        MultiResFeatureStore.open_or_create(
+            root, "d" * 64, cfg, (64, 64), 1, factor=2
+        )
+    # a leftover pooled tier from an OLDER trunk under a fresh hi tier:
+    # the derived-digest chain refuses the pairing
+    hi_root, lo_root = MultiResFeatureStore._roots(root, 2)
+    import shutil
+
+    shutil.rmtree(lo_root)
+    FeatureStore.create(
+        lo_root, pooled_digest("e" * 64, 2), cfg, (64, 64), 1
+    )
+    with pytest.raises(FeatureCacheMismatch):
+        MultiResFeatureStore.open_store(root, 2, expected_digest="c" * 64)
+
+
+def test_populate_store_multires_pools_the_same_trunk_pass(tmp_path):
+    """End-to-end: one trunk forward fills BOTH tiers; the stored lo
+    features equal pooling the stored hi features (they came from the
+    same pass), and re-populating a complete store is a no-op."""
+    from ncnet_tpu.data.pairs import SyntheticPairDataset
+    from ncnet_tpu.features import (
+        MultiResFeatureStore,
+        populate_store_multires,
+        trunk_digest,
+    )
+
+    cfg = ImMatchNetConfig(
+        feature_extraction_cnn="patch16", ncons_kernel_sizes=(3,),
+        ncons_channels=(1,),
+    )
+    params = init_immatchnet(jax.random.PRNGKey(9), cfg)
+    ds = SyntheticPairDataset(n=4, output_size=(64, 64), seed=11)
+    store = MultiResFeatureStore.open_or_create(
+        str(tmp_path / "mr"),
+        trunk_digest(params["feature_extraction"], cfg, (64, 64)),
+        cfg, (64, 64), len(ds), factor=2,
+    )
+    assert populate_store_multires(
+        store, params, cfg, ds, batch_size=2
+    ) == 4
+    assert store.complete()
+    (src_hi, _), (src_lo, _) = store.get(2)
+    assert src_hi.shape[:2] == (4, 4) and src_lo.shape[:2] == (2, 2)
+    np.testing.assert_allclose(
+        np.asarray(src_lo),
+        np.asarray(pool_features(jnp.asarray(src_hi)[None], 2)[0]),
+        rtol=1e-5, atol=1e-6,
+    )
+    assert populate_store_multires(store, params, cfg, ds) == 0  # lazy
+
+
+# --- PCK: refinement beats its own coarse band -------------------------------
+
+
+def test_synthetic_pck_refine_sweep():
+    """The accuracy side of the compute ladder, on the pretrained-free
+    synthetic construction (patch16 + identity NC): the factor-1
+    complete-band cell must equal dense EXACTLY (the chained exactness
+    anchor through the sweep API), and the factor-2 refined PCK must
+    beat the plain coarse band at the SAME K — re-scoring the survivors
+    at high res is what recovers the resolution the pool gave up."""
+    from ncnet_tpu.data.pairs import SyntheticPairDataset
+    from ncnet_tpu.eval.synthetic import (
+        synthetic_pck_vs_refine,
+        synthetic_pck_vs_topk,
+    )
+
+    size = 64  # patch16: fine grid 4x4, coarse 2x2 at factor 2
+    cfg = ImMatchNetConfig(
+        feature_extraction_cnn="patch16",
+        ncons_kernel_sizes=(3,), ncons_channels=(1,), nc_init="identity",
+    )
+    params = init_immatchnet(jax.random.PRNGKey(12), cfg)
+    ds = SyntheticPairDataset(
+        n=4, output_size=(size, size), seed=5, return_shift=True,
+        granularity=32,
+    )
+    batch = {
+        key: np.stack([ds[i][key] for i in range(len(ds))])
+        for key in ("source_image", "target_image", "shift")
+    }
+    sweep = synthetic_pck_vs_refine(
+        params, cfg, [batch], factors=(0, 1, 2), ks=(4, 16),
+        n_side=2, alpha=0.15,
+    )
+    dense = sweep[(0, 0)]
+    assert dense > 0.5  # the construction resolves shifts at all
+    # factor 1 at the complete band: the dense anchor through the sweep
+    assert sweep[(1, 16)] == pytest.approx(dense, abs=1e-7)
+    # factor 2: refinement recovers (at least) the coarse band's PCK of
+    # the SAME width measured on the POOLED pipeline
+    coarse_only = synthetic_pck_vs_topk(
+        params, cfg, [batch], ks=(4,), n_side=2, alpha=0.15
+    )
+    assert sweep[(2, 4)] >= 0.9 * coarse_only[4]
