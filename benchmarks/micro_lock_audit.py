@@ -1,0 +1,109 @@
+"""Microbench: overhead of the `make_lock` concurrency-audit seam.
+
+The audit's contract (ncnet_tpu/analysis/concurrency.py) is that the
+DISABLED path is free: `make_lock` decides at construction time, so a
+production serve stack with ``NCNET_LOCK_AUDIT`` unset holds exactly
+the `threading.Lock` objects it held before PR 16 — the only possible
+residue is the one `is_enabled()` check paid at LOCK CONSTRUCTION, not
+per acquisition. This bench pins that claim with numbers:
+
+  bare_lock     — ``with threading.Lock()`` acquire/release, the floor.
+  disabled_lock — the same loop over `make_lock`'s disabled product;
+                  the acceptance bar is <= 5% over bare (it is the SAME
+                  type, so any delta is measurement noise).
+  audited_lock  — the same loop over an enabled `OrderedLock` (held-set
+                  bookkeeping + perf_counter reads + edge recording);
+                  the price an NCNET_LOCK_AUDIT=1 chaos drill pays.
+
+Prints one JSON line with per-op nanoseconds and the disabled-vs-bare
+overhead percentage. Pure host bench: no jax, no device, stable on any
+box.
+
+Usage:
+  python benchmarks/micro_lock_audit.py [--iters 200000]
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ncnet_tpu.analysis import concurrency  # noqa: E402
+
+
+def _per_op_ns(fn, iters):
+    """min-of-5 per-op nanoseconds for ``fn(iters)`` (min discards
+    scheduler noise; the loop body carries the op)."""
+    best = min(fn(iters) for _ in range(5))
+    return best / iters * 1e9
+
+
+def _per_op_ns_paired(fn_a, fn_b, iters, rounds=7):
+    """min-of-rounds per-op ns for two benches measured in INTERLEAVED
+    rounds (a, b, a, b, ...) so warmup and frequency drift hit both
+    equally — the right shape for an A/B overhead claim."""
+    best_a = min(fn_a(iters) for _ in range(2))  # warm both first
+    best_b = min(fn_b(iters) for _ in range(2))
+    for _ in range(rounds):
+        best_a = min(best_a, fn_a(iters))
+        best_b = min(best_b, fn_b(iters))
+    return best_a / iters * 1e9, best_b / iters * 1e9
+
+
+def _bench_with(lock):
+    def run(iters):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            with lock:
+                pass
+        return time.perf_counter() - t0
+
+    return run
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=200_000)
+    args = p.parse_args()
+    iters = args.iters
+
+    concurrency.clear()
+    if concurrency.is_enabled():
+        raise RuntimeError("lock audit unexpectedly enabled at bench start")
+
+    bare = threading.Lock()
+    disabled = concurrency.make_lock("bench.disabled")
+    if type(disabled) is not type(bare):
+        raise RuntimeError(
+            f"disabled make_lock returned {type(disabled).__name__}, "
+            "not a bare lock — the 'disabled is free' contract is broken"
+        )
+
+    bare_ns, disabled_ns = _per_op_ns_paired(
+        _bench_with(bare), _bench_with(disabled), iters
+    )
+
+    concurrency.enable()
+    audited = concurrency.make_lock("bench.audited")
+    audited_ns = _per_op_ns(_bench_with(audited), iters)
+    concurrency.clear()
+
+    print(json.dumps({
+        "iters": iters,
+        "bare_lock_ns": round(bare_ns, 1),
+        "disabled_make_lock_ns": round(disabled_ns, 1),
+        # the acceptance number: must stay <= 5% (same type; noise only)
+        "disabled_overhead_pct": round(
+            (disabled_ns - bare_ns) / bare_ns * 100, 2
+        ),
+        "audited_lock_ns": round(audited_ns, 1),
+        "audited_multiplier": round(audited_ns / bare_ns, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

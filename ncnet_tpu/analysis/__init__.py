@@ -1,6 +1,6 @@
 """Static analysis + runtime numerical sanitizers for the framework.
 
-Two complementary halves (rule catalog and usage: README.md next to this
+Complementary halves (rule catalog and usage: README.md next to this
 file):
 
   * `engine` / `rules` / `cli` — an AST lint suite encoding the JAX/TPU
@@ -8,6 +8,12 @@ file):
     syncs inside compiled regions, eps-less divisions, unstable exp,
     Python branches on traced values, mutable defaults). CI gate:
     ``python scripts/lint.py ncnet_tpu scripts benchmarks``.
+  * `concurrency` — the lock-discipline prong: three AST rules over the
+    threaded serve/telemetry modules (registered into nclint via
+    `rules`) plus the opt-in ``NCNET_LOCK_AUDIT=1`` runtime audit
+    (`make_lock` / `OrderedLock` acquisition-graph cycle detection,
+    `ScheduleFuzzer` seeded interleaving perturbation). CI gate:
+    ``python scripts/lock_drill.py``.
   * `sanitizer` — per-stage finiteness / bf16-range probes behind
     ``--sanitize`` on scripts/train.py and bench.py; localizes a NaN to
     the first non-finite stage instead of a dead training run.

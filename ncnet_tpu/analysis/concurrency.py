@@ -1,0 +1,856 @@
+"""Concurrency auditing — the fourth audit level, two prongs.
+
+The first three audit levels (AST lint, jaxpr IR, post-fusion HLO) are
+blind to the most bug-dense layer of the system: the threaded serving
+stack. PRs 10/11 each shipped hand-found races (the ``Watchdog.stop``
+self-join, the ``_warm_specs`` dict-changed-size race, the
+``MicroBatcher`` lost-request hang). This module attacks that class from
+both sides:
+
+**Static prong** — three nclint rules over the threaded modules:
+
+  * ``unguarded-shared-state``: per class, infer which ``self._*``
+    attributes are lock-guarded (written inside ``with self._lock:``, or
+    inside a helper documented ``# guarded-by: <lock>`` on its ``def``
+    line), then flag any read/write of a guarded attribute outside every
+    lock scope. One-level interprocedural reach through the PR-9
+    `ProjectIndex`: a call site that passes ``self`` into another
+    module's helper is flagged when that helper writes a guarded
+    attribute and the call site holds no lock.
+  * ``lock-order-annotation``: a class holding >= 2 locks must declare
+    its acquisition order in a ``# lock-order: _a -> _b`` comment inside
+    the class body, and the comment must name exactly the class's lock
+    attributes (stale annotations are findings too).
+  * ``unjoined-thread``: a ``threading.Thread`` constructed without
+    ``daemon=True`` in a scope that never calls ``.join`` leaks at
+    shutdown; join it or register it in a thread ledger.
+
+All three honour the engine's suppression-with-reason discipline
+(``# nclint: disable=<rule> -- <why>``).
+
+**Runtime prong** — opt-in instrumented locks behind ``NCNET_LOCK_AUDIT=1``
+(same env-gated discipline as `resilience.faultinject`; exact no-op when
+disabled):
+
+  * `make_lock(name)` is the factory every audited module uses. Disabled
+    (the default) it returns a BARE ``threading.Lock``/``RLock`` — zero
+    wrapper, zero overhead, byte-identical behaviour (the <= 5% overhead
+    acceptance bar is met by construction; `benchmarks/micro_lock_audit.py`
+    measures it anyway). Enabled, it returns an `OrderedLock` that records
+    the per-thread lock-acquisition graph, detects lock-order cycles
+    (potential deadlock) and held-lock wall-time outliers, and reports
+    through the shared `findings.py` model (pseudo-path ``lock:<name>``,
+    like the auditor's ``jaxpr:<program>``).
+  * `ScheduleFuzzer` inserts randomized-but-SEEDED yields at every
+    instrumented lock boundary, so chaos drills (`tests/test_fleet.py`
+    kill/rejoin/drain) double as schedule-exploration runs and
+    interleaving regressions (the PR-11 lost-request bug) get replayable
+    coverage instead of one lucky schedule.
+
+Because `make_lock` decides at CONSTRUCTION time, enabling the audit
+mid-run only instruments locks created afterwards — enable before
+building the engine/fleet under test (the chaos drills and
+`scripts/lock_drill.py` do).
+"""
+
+import ast
+import itertools
+import os
+import random
+import re
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ncnet_tpu.analysis.engine import ModuleContext, rule
+from ncnet_tpu.analysis.findings import Finding
+
+# ---------------------------------------------------------------------------
+# Static prong: lock-discipline AST rules
+# ---------------------------------------------------------------------------
+
+#: canonical callables whose result is a lock attribute
+_LOCK_FACTORY_NAMES = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "ncnet_tpu.analysis.concurrency.make_lock",
+}
+
+#: ``.append`` etc. on a guarded container counts as a WRITE to it
+_MUTATOR_METHODS = {
+    "append", "appendleft", "add", "clear", "discard", "extend",
+    "insert", "pop", "popleft", "popitem", "remove", "setdefault",
+    "update",
+}
+
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z0-9_,\s]+)")
+_LOCK_ORDER_RE = re.compile(r"#\s*lock-order:\s*([A-Za-z0-9_>\s\-]+)")
+
+STATIC_RULE_IDS = (
+    "unguarded-shared-state",
+    "lock-order-annotation",
+    "unjoined-thread",
+)
+
+
+def _is_lock_factory(ctx: ModuleContext, node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = ctx.canonical(node.func)
+    if not name:
+        return False
+    return name in _LOCK_FACTORY_NAMES or name.endswith(".make_lock")
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """Attribute name when ``node`` is ``self.<attr>``, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _class_locks(ctx: ModuleContext, cls: ast.ClassDef) -> Set[str]:
+    """Attribute names assigned a lock-factory call anywhere in ``cls``."""
+    locks: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_lock_factory(ctx, node.value):
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr:
+                    locks.add(attr)
+    return locks
+
+
+def _direct_methods(cls: ast.ClassDef):
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _guarded_by_annotation(
+    lines: List[str], meth: ast.AST
+) -> Optional[Set[str]]:
+    """Lock names from a ``# guarded-by: <lock>`` comment on the def line."""
+    line = lines[meth.lineno - 1] if meth.lineno - 1 < len(lines) else ""
+    m = _GUARDED_BY_RE.search(line)
+    if not m:
+        return None
+    return {s.strip() for s in m.group(1).split(",") if s.strip()}
+
+
+class _Access:
+    __slots__ = ("node", "attr", "is_write", "held", "method")
+
+    def __init__(self, node, attr, is_write, held, method):
+        self.node = node
+        self.attr = attr
+        self.is_write = is_write
+        self.held = held
+        self.method = method
+
+
+def _scan_method(meth, locks: Set[str], seed_held: Set[str]):
+    """Walk the EXECUTED body of ``meth`` tracking the held-lock set.
+
+    Nested FunctionDef/AsyncFunctionDef/Lambda subtrees are pruned
+    entirely — an inner def (a worker target, a gauge lambda) runs on its
+    own schedule, so neither its accesses nor its lock scopes say
+    anything about the enclosing method. Returns ``(accesses, calls)``
+    where ``calls`` carries each Call node with the held set at the call
+    site (for the one-level interprocedural pass).
+    """
+    accesses: List[_Access] = []
+    calls: List[Tuple[ast.Call, frozenset]] = []
+    name = meth.name
+
+    def record(node, attr, is_write, held):
+        accesses.append(_Access(node, attr, is_write, frozenset(held), name))
+
+    def walk(node, held):
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            return
+        if isinstance(node, ast.With):
+            acquired = set()
+            for item in node.items:
+                walk(item.context_expr, held)
+                attr = _self_attr(item.context_expr)
+                if attr and attr in locks:
+                    acquired.add(attr)
+            inner = held | acquired
+            for stmt in node.body:
+                walk(stmt, inner)
+            return
+        if isinstance(node, ast.Call):
+            calls.append((node, frozenset(held)))
+            fattr = node.func
+            if isinstance(fattr, ast.Attribute):
+                recv = _self_attr(fattr.value)
+                if recv and fattr.attr in _MUTATOR_METHODS:
+                    record(fattr.value, recv, True, held)
+        elif isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            attr = _self_attr(node.value)
+            if attr:
+                record(node.value, attr, True, held)
+        elif isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr:
+                record(node, attr, isinstance(node.ctx, (ast.Store, ast.Del)), held)
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    for stmt in meth.body:
+        walk(stmt, set(seed_held))
+    return accesses, calls
+
+
+def _interproc_guarded_writes(ctx, call, guarded):
+    """Guarded attrs a resolved cross-module callee writes via ``self``.
+
+    Only fires when the call passes a bare ``self`` positionally and the
+    callee is a top-level function of another indexed module (one level,
+    same reach contract as every other interprocedural rule).
+    """
+    from ncnet_tpu.analysis.rules import _resolve_foreign_call, _walk_executed
+
+    self_pos = None
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Name) and arg.id == "self":
+            self_pos = i
+            break
+    if self_pos is None:
+        return ()
+    _name, info = _resolve_foreign_call(ctx, call)
+    if info is None:
+        return ()
+    params = [a.arg for a in info.node.args.args]
+    if self_pos >= len(params):
+        return ()
+    pname = params[self_pos]
+    written = set()
+    for node in _walk_executed(info.node):
+        target = None
+        if isinstance(node, ast.Attribute) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            target = node
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ) and node.func.attr in _MUTATOR_METHODS:
+            target = node.func.value
+        if (
+            target is not None
+            and isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == pname
+            and target.attr in guarded
+        ):
+            written.add(target.attr)
+    if not written:
+        return ()
+    return sorted(written), info.module
+
+
+@rule(
+    "unguarded-shared-state",
+    "warning",
+    doc="A `self._*` attribute this class writes under a lock is read or "
+        "written elsewhere with NO lock held — a data race unless the "
+        "access is intentionally racy (then suppress with the reason). "
+        "Guardedness is inferred from `with self._lock:` bodies and "
+        "`# guarded-by: <lock>` method annotations.",
+)
+def unguarded_shared_state(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    if ctx.is_test:
+        return
+    lines = ctx.source.splitlines()
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        locks = _class_locks(ctx, cls)
+        if not locks:
+            continue
+
+        per_method = []  # (meth, accesses, calls)
+        for meth in _direct_methods(cls):
+            ann = _guarded_by_annotation(lines, meth)
+            if ann is not None:
+                unknown = ann - locks
+                if unknown:
+                    yield meth, (
+                        f"# guarded-by: names {sorted(unknown)} but "
+                        f"{cls.name} has no such lock attribute(s) "
+                        f"(locks: {sorted(locks)})"
+                    )
+                ann &= locks
+            accesses, calls = _scan_method(meth, locks, ann or set())
+            per_method.append((meth, accesses, calls))
+
+        # evidence: attr -> (locks seen held at writes, first witness)
+        guarded: Dict[str, Set[str]] = {}
+        witness: Dict[str, Tuple[str, str]] = {}
+        for meth, accesses, _calls in per_method:
+            if meth.name == "__init__":
+                continue
+            for a in accesses:
+                if (
+                    a.is_write
+                    and a.held
+                    and a.attr.startswith("_")
+                    and a.attr not in locks
+                ):
+                    guarded.setdefault(a.attr, set()).update(a.held)
+                    witness.setdefault(a.attr, (sorted(a.held)[0], a.method))
+
+        if not guarded:
+            continue
+
+        flagged = set()
+        for meth, accesses, calls in per_method:
+            if meth.name == "__init__":
+                continue
+            for a in accesses:
+                g = guarded.get(a.attr)
+                if g is None or a.held & g:
+                    continue
+                key = (a.node.lineno, a.attr)
+                if key in flagged:
+                    continue
+                flagged.add(key)
+                lock_name, where = witness[a.attr]
+                kind = "written" if a.is_write else "read"
+                yield a.node, (
+                    f"self.{a.attr} {kind} without holding "
+                    f"self.{lock_name} (written under it in "
+                    f"{cls.name}.{where}); take the lock or suppress "
+                    f"with the reason the race is benign"
+                )
+            if ctx.project is not None:
+                for call, held in calls:
+                    if held:
+                        continue
+                    hit = _interproc_guarded_writes(ctx, call, set(guarded))
+                    if not hit:
+                        continue
+                    attrs, mod = hit
+                    key = (call.lineno, tuple(attrs))
+                    if key in flagged:
+                        continue
+                    flagged.add(key)
+                    yield call, (
+                        f"call passes self into {mod} which writes "
+                        f"guarded attribute(s) {attrs} — no lock held "
+                        f"at this call site"
+                    )
+
+
+@rule(
+    "lock-order-annotation",
+    "warning",
+    doc="A class holding >= 2 locks must declare its acquisition order "
+        "with a `# lock-order: _a -> _b` comment in the class body, and "
+        "the comment must name exactly the class's lock attributes. The "
+        "runtime OrderedLock audit verifies the declared order is the "
+        "observed one.",
+)
+def lock_order_annotation(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    if ctx.is_test:
+        return
+    lines = ctx.source.splitlines()
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        locks = _class_locks(ctx, cls)
+        if len(locks) < 2:
+            continue
+        end = getattr(cls, "end_lineno", None) or len(lines)
+        declared = None
+        for lineno in range(cls.lineno, min(end, len(lines)) + 1):
+            m = _LOCK_ORDER_RE.search(lines[lineno - 1])
+            if m:
+                declared = [
+                    s.strip() for s in m.group(1).split("->") if s.strip()
+                ]
+                break
+        if declared is None:
+            yield cls, (
+                f"{cls.name} holds {len(locks)} locks "
+                f"({', '.join(sorted(locks))}) but declares no "
+                f"acquisition order; add '# lock-order: "
+                f"{' -> '.join(sorted(locks))}' (in the true order)"
+            )
+        elif set(declared) != locks or len(declared) != len(set(declared)):
+            yield cls, (
+                f"{cls.name} lock-order annotation is stale: declares "
+                f"({', '.join(declared)}) but the class's locks are "
+                f"({', '.join(sorted(locks))})"
+            )
+
+
+@rule(
+    "unjoined-thread",
+    "warning",
+    doc="`threading.Thread` constructed without daemon=True in a scope "
+        "that never calls `.join` — the thread outlives shutdown and "
+        "leaks. Join it (the serve stack's thread-ledger pattern), make "
+        "it a daemon, or suppress with the reason.",
+)
+def unjoined_thread(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    if ctx.is_test:
+        return
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(ctx.tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if ctx.canonical(node.func) != "threading.Thread":
+            continue
+        daemon = next(
+            (kw.value for kw in node.keywords if kw.arg == "daemon"), None
+        )
+        if isinstance(daemon, ast.Constant) and daemon.value is True:
+            continue
+        # nearest enclosing function; widen to the class for methods so a
+        # start-in-one-method / join-in-shutdown split is not a finding
+        scope: ast.AST = ctx.tree
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = cur
+                holder = parents.get(cur)
+                if isinstance(holder, ast.ClassDef):
+                    scope = holder
+                break
+            cur = parents.get(cur)
+        joins = any(
+            isinstance(n, ast.Attribute) and n.attr == "join"
+            for n in ast.walk(scope)
+        )
+        if not joins:
+            scope_name = getattr(scope, "name", "<module>")
+            yield node, (
+                f"Thread created without daemon=True and never joined in "
+                f"{scope_name}; join it at shutdown, register it in a "
+                f"thread ledger, or mark it daemon"
+            )
+
+
+# ---------------------------------------------------------------------------
+# Runtime prong: OrderedLock / ScheduleFuzzer behind NCNET_LOCK_AUDIT=1
+# ---------------------------------------------------------------------------
+
+ENV_VAR = "NCNET_LOCK_AUDIT"
+
+RUNTIME_RULE_IDS = ("lock-order-cycle", "lock-held-outlier")
+
+_DEFAULT_OUTLIER_S = 0.5
+_OUTLIER_CAP_PER_LOCK = 3
+
+_meta_lock = threading.Lock()
+_enabled = False
+_env_loaded = False
+_default_outlier_s = _DEFAULT_OUTLIER_S
+#: (held_name, acquired_name) -> observation count
+_edges: Dict[Tuple[str, str], int] = {}
+#: name -> [acquire_count, total_held_s, max_held_s]
+_held: Dict[str, List[float]] = {}
+_outliers: List[Finding] = []
+_outlier_counts: Dict[str, int] = {}
+_fuzzer: Optional["ScheduleFuzzer"] = None
+_tls = threading.local()
+
+
+def _held_stack() -> List[Tuple[str, float]]:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def _ensure_env_loaded():
+    global _env_loaded, _enabled
+    if _env_loaded:
+        return
+    with _meta_lock:
+        if _env_loaded:
+            return
+        _enabled = os.environ.get(ENV_VAR, "") == "1"
+        _env_loaded = True
+
+
+def is_enabled() -> bool:
+    _ensure_env_loaded()
+    return _enabled
+
+
+def enable(held_outlier_s: Optional[float] = None):
+    """Turn the lock audit on for locks created AFTER this call."""
+    global _enabled, _env_loaded, _default_outlier_s
+    with _meta_lock:
+        _enabled = True
+        _env_loaded = True
+        if held_outlier_s is not None:
+            _default_outlier_s = float(held_outlier_s)
+
+
+def disable():
+    global _enabled, _env_loaded
+    with _meta_lock:
+        _enabled = False
+        _env_loaded = True
+
+
+def clear():
+    """Reset graph + findings and disable (beats a stale env var, same
+    contract as `faultinject.clear`)."""
+    global _enabled, _env_loaded, _fuzzer, _default_outlier_s
+    with _meta_lock:
+        _enabled = False
+        _env_loaded = True
+        _default_outlier_s = _DEFAULT_OUTLIER_S
+        _edges.clear()
+        _held.clear()
+        _outliers.clear()
+        _outlier_counts.clear()
+        _fuzzer = None
+
+
+def make_lock(
+    name: str,
+    reentrant: bool = False,
+    held_outlier_s: Optional[float] = None,
+):
+    """The lock constructor every audited module uses.
+
+    Disabled (default): returns a BARE ``threading.Lock``/``RLock`` —
+    the audit costs nothing because there is nothing there. Enabled:
+    returns an `OrderedLock` recording the acquisition graph.
+    ``held_outlier_s`` overrides the outlier threshold for locks that
+    legitimately block for long stretches (e.g. the engine's compile
+    lock, held across multi-second AOT compiles).
+    """
+    _ensure_env_loaded()
+    if not _enabled:
+        return threading.RLock() if reentrant else threading.Lock()
+    return OrderedLock(name, reentrant=reentrant, held_outlier_s=held_outlier_s)
+
+
+class OrderedLock:
+    """Instrumented lock: per-thread acquisition-order edges + held time.
+
+    Wraps a real ``threading.Lock``/``RLock``; the audit state (edge
+    graph, held-time stats, outlier findings) is module-global so cycles
+    ACROSS locks and threads are visible. Lock NAMES aggregate across
+    instances — every replica's ``serve.engine.gen`` is one graph node —
+    which is what makes order inversions between two code paths visible
+    no matter which instances they ran on. Reentrant re-acquisition adds
+    no self-edges.
+    """
+
+    __slots__ = ("name", "_lock", "_outlier_s", "reentrant")
+
+    def __init__(self, name, reentrant=False, held_outlier_s=None):
+        self.name = name
+        self.reentrant = reentrant
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+        self._outlier_s = held_outlier_s
+
+    def acquire(self, blocking=True, timeout=-1):
+        fz = _fuzzer
+        if fz is not None:
+            fz.maybe_yield()
+        stack = _held_stack()
+        if _enabled and stack:
+            with _meta_lock:
+                for held_name, _t0 in stack:
+                    if held_name != self.name:
+                        edge = (held_name, self.name)
+                        _edges[edge] = _edges.get(edge, 0) + 1
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            stack.append((self.name, time.perf_counter()))
+        return ok
+
+    def release(self):
+        stack = _held_stack()
+        t0 = None
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] == self.name:
+                t0 = stack.pop(i)[1]
+                break
+        self._lock.release()
+        if t0 is not None and _enabled:
+            dt = time.perf_counter() - t0
+            threshold = (
+                self._outlier_s
+                if self._outlier_s is not None
+                else _default_outlier_s
+            )
+            with _meta_lock:
+                st = _held.setdefault(self.name, [0, 0.0, 0.0])
+                st[0] += 1
+                st[1] += dt
+                st[2] = max(st[2], dt)
+                if dt > threshold:
+                    n = _outlier_counts.get(self.name, 0)
+                    if n < _OUTLIER_CAP_PER_LOCK:
+                        _outlier_counts[self.name] = n + 1
+                        _outliers.append(
+                            Finding(
+                                f"lock:{self.name}", 1, 0,
+                                "lock-held-outlier", "warning",
+                                f"lock {self.name!r} held for {dt:.3f}s "
+                                f"(threshold {threshold:.3f}s) — a long "
+                                f"critical section starves every waiter",
+                                detail={"held_s": round(dt, 6),
+                                        "threshold_s": threshold},
+                            )
+                        )
+        fz = _fuzzer
+        if fz is not None:
+            fz.maybe_yield()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+        return False
+
+    def locked(self):
+        try:
+            return self._lock.locked()
+        except AttributeError:  # RLock pre-3.12 has no locked()
+            return False
+
+
+class ScheduleFuzzer:
+    """Seeded random yields at instrumented-lock boundaries.
+
+    Each thread derives its own ``random.Random`` from ``(seed, k)``
+    where ``k`` is the order the thread first hit a boundary — the
+    schedule PERTURBATION is deterministic per (seed, thread-arrival
+    order) even though the OS schedule underneath is not, which is
+    enough to replay an interleaving class (the PR-11 MicroBatcher
+    lost-request scenario) rather than one lucky schedule. Install via
+    ``with ScheduleFuzzer(seed=...):`` or install()/uninstall().
+    """
+
+    def __init__(self, seed: int, p: float = 0.25, max_sleep_s: float = 1e-4):
+        self.seed = int(seed)
+        self.p = float(p)
+        self.max_sleep_s = float(max_sleep_s)
+        self._counter = itertools.count()
+        self._local = threading.local()
+
+    def _rng(self) -> random.Random:
+        rng = getattr(self._local, "rng", None)
+        if rng is None:
+            # int mix (not a tuple: hash-based Random seeding is
+            # deprecated); the odd multiplier keeps streams disjoint
+            rng = self._local.rng = random.Random(
+                self.seed * 1_000_003 + next(self._counter)
+            )
+        return rng
+
+    def maybe_yield(self):
+        rng = self._rng()
+        if rng.random() < self.p:
+            time.sleep(rng.random() * self.max_sleep_s)
+
+    def install(self) -> "ScheduleFuzzer":
+        global _fuzzer
+        with _meta_lock:
+            _fuzzer = self
+        return self
+
+    def uninstall(self):
+        global _fuzzer
+        with _meta_lock:
+            if _fuzzer is self:
+                _fuzzer = None
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.uninstall()
+        return False
+
+
+def acquisition_edges() -> Dict[Tuple[str, str], int]:
+    """Copy of the observed (held -> acquired) edge counts."""
+    with _meta_lock:
+        return dict(_edges)
+
+
+def held_stats() -> Dict[str, dict]:
+    with _meta_lock:
+        return {
+            name: {
+                "acquires": int(st[0]),
+                "total_held_s": st[1],
+                "max_held_s": st[2],
+            }
+            for name, st in sorted(_held.items())
+        }
+
+
+def find_cycles() -> List[List[str]]:
+    """Cycles in the acquisition graph, each a canonicalized lock-name
+    path (rotated to start at its smallest name); deterministic order.
+    A cycle means two code paths acquire the same locks in opposite
+    orders — a deadlock waiting for the right interleaving."""
+    with _meta_lock:
+        edges = list(_edges)
+    adj: Dict[str, Set[str]] = defaultdict(set)
+    for a, b in edges:
+        adj[a].add(b)
+
+    # iterative Tarjan SCC
+    index_of: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = itertools.count()
+
+    for root in sorted(adj):
+        if root in index_of:
+            continue
+        work = [(root, iter(sorted(adj.get(root, ()))))]
+        index_of[root] = low[root] = next(counter)
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index_of:
+                    index_of[w] = low[w] = next(counter)
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[v] = min(low[v], index_of[w])
+            if advanced:
+                continue
+            work.pop()
+            if low[v] == index_of[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                if len(scc) > 1:
+                    sccs.append(scc)
+            if work:
+                u = work[-1][0]
+                low[u] = min(low[u], low[v])
+
+    cycles: List[List[str]] = []
+    edge_set = set(edges)
+    for scc in sccs:
+        members = set(scc)
+        start = min(members)
+        # shortest concrete cycle back to `start` inside the SCC (BFS)
+        prev = {start: None}
+        frontier = [start]
+        found = None
+        while frontier and found is None:
+            nxt = []
+            for u in frontier:
+                for w in sorted(adj.get(u, ())):
+                    if w == start:
+                        found = u
+                        break
+                    if w in members and w not in prev:
+                        prev[w] = u
+                        nxt.append(w)
+                if found is not None:
+                    break
+            frontier = nxt
+        if found is None:  # defensive: SCC guarantee says unreachable
+            continue
+        path = [found]
+        while prev[path[-1]] is not None:
+            path.append(prev[path[-1]])
+        path.reverse()
+        if any((a, b) not in edge_set for a, b in zip(path, path[1:])):
+            continue  # defensive: BFS inside an SCC only walks real edges
+        cycles.append(path)
+    cycles.sort()
+    return cycles
+
+
+def lock_findings() -> List[Finding]:
+    """Cycle + outlier findings in the shared `Finding` model."""
+    findings: List[Finding] = []
+    with _meta_lock:
+        edge_counts = dict(_edges)
+        findings.extend(_outliers)
+    for cycle in find_cycles():
+        loop = cycle + [cycle[0]]
+        arrows = " -> ".join(loop)
+        obs = sum(
+            edge_counts.get((a, b), 0) for a, b in zip(loop, loop[1:])
+        )
+        findings.append(
+            Finding(
+                f"lock:{cycle[0]}", 1, 0, "lock-order-cycle", "error",
+                f"lock-order cycle: {arrows} (potential deadlock; "
+                f"{obs} edge observation(s)) — pick one order and fix "
+                f"the inverted acquisition",
+                detail={"cycle": list(cycle), "observations": obs},
+            )
+        )
+    findings.sort(key=lambda f: (f.path, f.rule, f.message))
+    return findings
+
+
+def runtime_rules_meta() -> Dict[str, dict]:
+    """Rule metadata for SARIF emission (same shape as `lint_rules_meta`)."""
+    return {
+        "lock-order-cycle": {
+            "severity": "error",
+            "doc": "Two threads acquired the same locks in opposite "
+                   "orders during an audited run — a deadlock under the "
+                   "right interleaving.",
+        },
+        "lock-held-outlier": {
+            "severity": "warning",
+            "doc": "An audited lock was held longer than its outlier "
+                   "threshold; long critical sections starve waiters and "
+                   "hide in p99 latency.",
+        },
+    }
+
+
+def report() -> dict:
+    """One-call summary: enabled flag, per-lock stats, edges, cycles."""
+    return {
+        "enabled": is_enabled(),
+        "locks": held_stats(),
+        "edges": {
+            f"{a} -> {b}": n
+            for (a, b), n in sorted(acquisition_edges().items())
+        },
+        "cycles": find_cycles(),
+        "findings": [f.to_dict() for f in lock_findings()],
+    }

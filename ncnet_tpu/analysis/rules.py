@@ -1056,3 +1056,11 @@ def swallowed_exception(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
                 "metric) — deliberate best-effort probes need a "
                 "reasoned suppression"
             )
+
+
+# --- concurrency rules (fourth audit level) ---------------------------------
+# Importing registers `unguarded-shared-state`, `lock-order-annotation`
+# and `unjoined-thread`; the module also carries the runtime OrderedLock
+# prong (see its docstring). Kept at the bottom: concurrency.py imports
+# helpers from THIS module lazily inside its rule bodies.
+from ncnet_tpu.analysis import concurrency  # noqa: E402,F401

@@ -26,8 +26,9 @@ itself never blocks.
 """
 
 import dataclasses
-import threading
 import time
+
+from ncnet_tpu.analysis import concurrency
 from typing import Callable, List, Optional, Sequence
 
 
@@ -124,7 +125,7 @@ class MicroBatcher:
                 f"max_batch={max_batch} group"
             )
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = concurrency.make_lock("serve.batcher")
         # key -> (oldest-add time, [Request, ...]); insertion-ordered so
         # deadline scans see oldest groups first
         self._groups = {}

@@ -78,6 +78,7 @@ import numpy as np
 
 import jax
 
+from ncnet_tpu.analysis import concurrency
 from ncnet_tpu.data.loader import retry_call
 from ncnet_tpu.resilience import faultinject
 from ncnet_tpu.serve.batcher import (
@@ -373,8 +374,16 @@ class ServeEngine:
             self.controller = HysteresisController()
         else:
             self.controller = None
+        # lock-order: _close_lock -> _gen_lock -> _compile_lock -> _pending_lock
+        # (no pair is ever truly nested today; the declared order is the
+        # one any future nesting must follow, and the NCNET_LOCK_AUDIT=1
+        # drills verify the observed graph stays acyclic)
         self._compiled = {}  # (key, padded size, variant, sharded) -> exe
-        self._compile_lock = threading.Lock()
+        # held across multi-second AOT compiles by design, hence the
+        # raised held-time outlier threshold
+        self._compile_lock = concurrency.make_lock(
+            "serve.engine.compile", held_outlier_s=300.0
+        )
         self._warm = False
         # every (key, per-sample spec) warmup has seen: the fleet re-warms
         # a rejoining replica from exactly this set, so
@@ -385,7 +394,11 @@ class ServeEngine:
         self._batch_q = queue.Queue()
         self._readout_q = queue.Queue(maxsize=readout_depth)
         self._closed = False
-        self._close_lock = threading.Lock()
+        # held across the drain wait in kill() on the already-closed
+        # path, so its outlier threshold tracks a full drain
+        self._close_lock = concurrency.make_lock(
+            "serve.engine.close", held_outlier_s=60.0
+        )
         self._drained = threading.Event()
         self._stop_dispatch = threading.Event()
 
@@ -394,7 +407,7 @@ class ServeEngine:
         # is failed with a typed shed, so 100% of accepted futures
         # resolve before shutdown returns
         self._pending = set()
-        self._pending_lock = threading.Lock()
+        self._pending_lock = concurrency.make_lock("serve.engine.pending")
 
         # Engine stats live in a telemetry metrics registry; `report()`
         # is a VIEW over it. Private per engine by default (co-resident
@@ -526,12 +539,18 @@ class ServeEngine:
         # generation and starts a fresh thread; the wedged one discards
         # its work when it wakes (a Python thread cannot be killed)
         self._dispatch_gen = 0
-        self._gen_lock = threading.Lock()
+        self._gen_lock = concurrency.make_lock("serve.engine.gen")
         self._inflight_dispatch = {}  # gen -> the batch on the device
         self._dispatch_beat = clock()
         self._reader = threading.Thread(
             target=self._readout_worker, name="serve-readout", daemon=True
         )
+        # ledger of EVERY thread the engine ever started (prep workers,
+        # each dispatcher generation, readout, watchdog): shutdown joins
+        # the whole list under a bounded budget and report() names the
+        # stragglers. Append-only from the starting thread; list.append
+        # is atomic under the GIL.
+        self._thread_ledger = list(self._workers) + [self._reader]
         for t in self._workers:
             t.start()
         self._start_dispatcher()
@@ -540,11 +559,12 @@ class ServeEngine:
         if hang_timeout is not None:
             self._watchdog = Watchdog(
                 hang_timeout,
-                beat_fn=lambda: self._dispatch_beat,
-                busy_fn=lambda: bool(self._inflight_dispatch),
+                beat_fn=lambda: self.heartbeat,
+                busy_fn=lambda: self.busy,
                 on_hang=self._on_dispatch_hang,
                 clock=clock,
             ).start()
+            self._thread_ledger.append(self._watchdog.thread)
 
     # ------------------------------------------------------------------
     # compile management
@@ -587,7 +607,7 @@ class ServeEngine:
     def _executable(self, key, bs, pspec, live, variant="standard",
                     sharded=False):
         ck = (key, bs, variant, sharded)
-        exe = self._compiled.get(ck)
+        exe = self._compiled.get(ck)  # nclint: disable=unguarded-shared-state -- double-checked fast path: dict.get is atomic under the GIL and a miss re-checks under _compile_lock below
         if exe is not None:
             return exe
         if sharded:
@@ -644,7 +664,8 @@ class ServeEngine:
                     self._executable(key, bs, pspec, live=False,
                                      sharded=True)
         self._warm = True
-        return len(self._compiled)
+        with self._compile_lock:
+            return len(self._compiled)
 
     @property
     def compile_count(self):
@@ -673,7 +694,7 @@ class ServeEngine:
         ``serve_requests_shed_total``). An accepted request whose
         deadline expires in-pipeline resolves with `DeadlineExceeded`.
         """
-        if self._closed:
+        if self._closed:  # nclint: disable=unguarded-shared-state -- benign racy read of the monotonic close flag: kill() holds _close_lock across the drain wait, so a locked read here would block every submitter for a full drain
             raise RuntimeError("submit on a closed ServeEngine")
         if raw is None:
             if key is None or payload is None:
@@ -803,11 +824,13 @@ class ServeEngine:
     # -- dispatch stage ------------------------------------------------
 
     def _start_dispatcher(self):
-        gen = self._dispatch_gen
+        with self._gen_lock:
+            gen = self._dispatch_gen
         self._dispatcher = threading.Thread(
             target=self._dispatch_worker, args=(gen,),
             name=f"serve-dispatch-{gen}", daemon=True,
         )
+        self._thread_ledger.append(self._dispatcher)
         self._dispatcher.start()
 
     def _dispatch_worker(self, gen):
@@ -851,9 +874,10 @@ class ServeEngine:
 
     def _dispatch_loop(self, gen):
         while True:
-            if self._dispatch_gen != gen:
+            if self._dispatch_gen != gen:  # nclint: disable=unguarded-shared-state -- advisory lock-free generation check: the authoritative check runs under _gen_lock in _dispatch, and settlement is InvalidStateError-guarded
                 return  # superseded by hang recovery
-            self._dispatch_beat = self._clock()
+            with self._gen_lock:
+                self._dispatch_beat = self._clock()
             self._update_degrade()
             stopping = self._stop_dispatch.is_set()
             nd = self._batcher.next_deadline()
@@ -864,7 +888,7 @@ class ServeEngine:
                 batch = self._batch_q.get(timeout=wait)
             except queue.Empty:
                 batch = None
-            if self._dispatch_gen != gen:
+            if self._dispatch_gen != gen:  # nclint: disable=unguarded-shared-state -- advisory lock-free generation check: the authoritative check runs under _gen_lock in _dispatch, and settlement is InvalidStateError-guarded
                 if batch is not None:
                     self._batch_q.put(batch)  # hand back to the successor
                 return
@@ -892,7 +916,7 @@ class ServeEngine:
         # `_inflight_dispatch` set so the supervisor/watchdog can fail
         # exactly the in-flight batch.
         faultinject.fire("serve.dispatch.hang")
-        if self._dispatch_gen != gen:
+        if self._dispatch_gen != gen:  # nclint: disable=unguarded-shared-state -- advisory lock-free generation check: the pop below re-checks under _gen_lock and the watchdog already settled these futures
             # woke from a hang after supersession: the watchdog already
             # failed these futures; discard
             with self._gen_lock:
@@ -957,7 +981,7 @@ class ServeEngine:
             for r in batch.requests:
                 self._fail(r.future, exc)
             return
-        if self._dispatch_gen != gen:
+        if self._dispatch_gen != gen:  # nclint: disable=unguarded-shared-state -- advisory lock-free generation check: a stale read only delays the discard one step; the watchdog already settled the batch under _gen_lock
             return  # superseded mid-call; the watchdog settled the batch
         self._readout_q.put((batch, out, t_dispatch, variant))
 
@@ -1111,7 +1135,7 @@ class ServeEngine:
 
     @property
     def closed(self):
-        return self._closed
+        return self._closed  # nclint: disable=unguarded-shared-state -- benign racy read of the monotonic close flag: kill() holds _close_lock across the drain wait, so a locked read could block for a full drain
 
     # -- the fleet's view of one replica -------------------------------
 
@@ -1120,13 +1144,15 @@ class ServeEngine:
         """Last dispatch-loop heartbeat on the engine clock — the fleet
         watchdog's ``beat_fn`` (the internal hang watchdog reads the same
         field)."""
-        return self._dispatch_beat
+        with self._gen_lock:
+            return self._dispatch_beat
 
     @property
     def busy(self):
         """True while a batch is on the device (the watchdog's
         ``busy_fn``: an idle replica that stops beating is not hung)."""
-        return bool(self._inflight_dispatch)
+        with self._gen_lock:
+            return bool(self._inflight_dispatch)
 
     @property
     def max_wait(self):
@@ -1253,7 +1279,15 @@ class ServeEngine:
         }
         s["mean_occupancy"] = self._mean_occupancy()
         s["compiles"] = self._trace_count
-        s["compiled_programs"] = len(self._compiled)
+        with self._compile_lock:
+            s["compiled_programs"] = len(self._compiled)
+        # threads from the ledger still alive after the drain settled —
+        # populated only post-close so a live engine's worker pool is
+        # not reported as a leak
+        s["straggler_threads"] = (
+            sorted(t.name for t in self._thread_ledger if t.is_alive())
+            if self._drained.is_set() else []
+        )
         for p, v in percentiles(lat).items():
             s[f"latency_{p}_ms"] = v * 1e3
         s["latencies_s"] = lat
@@ -1300,6 +1334,21 @@ class ServeEngine:
         self._reader.join(remaining())
         if self._watchdog is not None:
             self._watchdog.stop(remaining())
+        # thread-ledger sweep: join EVERY thread the engine ever started,
+        # under a small bounded budget (a superseded dispatch generation
+        # may be wedged by design — the watchdog drill leaves one parked
+        # on a fault injection); whatever survives shows up in report()'s
+        # straggler_threads instead of leaking silently
+        ledger_deadline = self._clock() + 0.5
+        for t in self._thread_ledger:
+            if t is threading.current_thread():
+                continue
+            budget = ledger_deadline - self._clock()
+            r = remaining()
+            if r is not None:
+                budget = min(budget, r)
+            if budget > 0 and t.is_alive():
+                t.join(budget)
         # the drain ledger: anything still pending missed the deadline
         with self._pending_lock:
             leftovers = list(self._pending)

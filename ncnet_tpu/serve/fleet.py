@@ -41,6 +41,7 @@ from concurrent.futures import Future, InvalidStateError
 
 import jax
 
+from ncnet_tpu.analysis import concurrency
 from ncnet_tpu.resilience import faultinject
 from ncnet_tpu.resilience.faultinject import InjectedFault
 from ncnet_tpu.serve.engine import ServeEngine
@@ -122,15 +123,19 @@ class ServeFleet:
         self._hang_timeout = replica_hang_timeout
         self._clock = clock
         self._closed = False
-        self._close_lock = threading.Lock()
+        # lock-order: _close_lock -> _lock -> _pending_lock
+        # (never actually nested today; the declared order binds any
+        # future nesting, checked by the NCNET_LOCK_AUDIT=1 drills)
+        self._close_lock = concurrency.make_lock("serve.fleet.close")
 
-        self._lock = threading.Lock()  # replica table + quarantine + warm specs
+        # replica table + quarantine + warm specs
+        self._lock = concurrency.make_lock("serve.fleet.replicas")
         self._replicas = {}  # rid -> _Replica (healthy, routable)
         self._quarantined = {}  # rid -> device (killed, awaiting rejoin)
         self._warm_specs = {}  # key -> per-sample spec (rejoin re-warms)
 
         self._pending = set()
-        self._pending_lock = threading.Lock()
+        self._pending_lock = concurrency.make_lock("serve.fleet.pending")
         self._requeue_q = queue.Queue()
 
         self.metrics = registry if registry is not None else MetricsRegistry()
@@ -171,12 +176,18 @@ class ServeFleet:
             "fleet_rejoins_total", "quarantined replicas re-warmed back in"
         )
 
+        # fleet-owned threads (requeue + per-replica watchdogs): close()
+        # joins the whole ledger bounded and report() names stragglers.
+        # Append-only; list.append is atomic under the GIL.
+        self._thread_ledger = []
+
         for i in range(replicas):
             self._start_replica(i, devices[i % len(devices)])
 
         self._requeue_thread = threading.Thread(
             target=self._requeue_loop, name="fleet-requeue", daemon=True
         )
+        self._thread_ledger.append(self._requeue_thread)
         self._requeue_thread.start()
 
     # -- replica lifecycle ---------------------------------------------
@@ -198,6 +209,7 @@ class ServeFleet:
                 ),
                 clock=self._clock,
             ).start()
+            self._thread_ledger.append(watchdog.thread)
         with self._lock:
             self._replicas[rid] = _Replica(engine, watchdog, device)
         return engine
@@ -287,7 +299,7 @@ class ServeFleet:
         batch dies. Routing failures resolve the RETURNED future (typed
         `RequestShed`), they do not raise, so callers have exactly one
         error channel."""
-        if self._closed:
+        if self._closed:  # nclint: disable=unguarded-shared-state -- benign racy read of the monotonic close flag: close() settles every pending future after the flip, so a submit that races it still resolves
             raise RuntimeError("submit on a closed ServeFleet")
         deadline_abs = (
             None if deadline_s is None else self._clock() + deadline_s
@@ -350,7 +362,7 @@ class ServeFleet:
             # leaves them routable, so re-routing there would bounce
             # between closed replicas forever: shed typed instead
             if engine.closed:
-                if self._closed:
+                if self._closed:  # nclint: disable=unguarded-shared-state -- benign racy read of the monotonic close flag: the drain settles every pending future after the flip
                     self._settle_exc(record, RequestShed(
                         "fleet closed during placement", reason="drain",
                     ))
@@ -368,7 +380,7 @@ class ServeFleet:
         if exc is None:
             self._settle_result(record, inner.result())
         elif (isinstance(exc, ReplicaDown) and not exc.dispatched
-              and not self._closed):
+              and not self._closed):  # nclint: disable=unguarded-shared-state -- benign racy read of the monotonic close flag: a stale False only requeues once more and close() sheds the record typed
             # queued-but-undispatched on a dead replica: move it to a
             # survivor. Off-thread via the requeue queue — this callback
             # runs inside the killer's kill() loop, which must not block
@@ -422,7 +434,7 @@ class ServeFleet:
 
     @property
     def closed(self):
-        return self._closed
+        return self._closed  # nclint: disable=unguarded-shared-state -- benign racy read of a monotonic flag flipped once under _close_lock; observers need freshness, not atomicity
 
     def replica_ids(self):
         with self._lock:
@@ -462,6 +474,14 @@ class ServeFleet:
             "healthy": sorted(healthy),
             "quarantined": quarantined,
             "last_route": self._router.last_decision,
+            # ledger threads still alive after close() — empty on a live
+            # fleet (workers are SUPPOSED to be running then)
+            "straggler_threads": (
+                sorted(
+                    t.name for t in self._thread_ledger if t.is_alive()
+                )
+                if self._closed else []  # nclint: disable=unguarded-shared-state -- benign racy read of the monotonic close flag gating a diagnostic field
+            ),
             "per_replica": {
                 rid: eng.report() for rid, eng in healthy.items()
             },
@@ -484,9 +504,22 @@ class ServeFleet:
             reps = list(self._replicas.values())
         for rep in reps:
             if rep.watchdog is not None:
-                rep.watchdog.stop(join_timeout=0)
+                # bounded join: close() never runs ON a watchdog thread
+                # (kill_replica, which can, keeps join_timeout=0)
+                rep.watchdog.stop(join_timeout=0.5)
         for rep in reps:
             rep.engine.shutdown(timeout=timeout)
+        # thread-ledger sweep: whatever the joins above missed (e.g. a
+        # quarantined replica's stopped-but-unjoined watchdog) gets a
+        # bounded join here; survivors show in report()'s
+        # straggler_threads instead of leaking silently
+        ledger_deadline = self._clock() + 0.5
+        for t in self._thread_ledger:
+            if t is threading.current_thread():
+                continue
+            budget = ledger_deadline - self._clock()
+            if budget > 0 and t.is_alive():
+                t.join(budget)
         with self._pending_lock:
             leftovers = list(self._pending)
             self._pending.clear()

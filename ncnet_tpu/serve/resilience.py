@@ -68,6 +68,8 @@ import queue
 import threading
 import time
 
+from ncnet_tpu.analysis import concurrency
+
 
 class ServeResilienceError(RuntimeError):
     """Base of every typed serving-resilience outcome."""
@@ -167,7 +169,7 @@ class LatencyEstimator:
         if not 0.0 < alpha <= 1.0:
             raise ValueError(f"alpha must be in (0, 1], got {alpha}")
         self.alpha = alpha
-        self._lock = threading.Lock()
+        self._lock = concurrency.make_lock("serve.latency_estimator")
         self._per_key = {}
         self._global = None
 
@@ -392,6 +394,11 @@ class Watchdog:
     def start(self):
         self._thread.start()
         return self
+
+    @property
+    def thread(self):
+        """The watchdog's poll thread — for the owner's thread ledger."""
+        return self._thread
 
     def _loop(self):
         poll = self.timeout / 4.0

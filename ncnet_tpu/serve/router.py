@@ -36,6 +36,7 @@ every submit.
 
 import threading
 
+from ncnet_tpu.analysis import concurrency
 from ncnet_tpu.resilience import faultinject
 from ncnet_tpu.serve.resilience import RequestShed
 
@@ -80,7 +81,7 @@ class FleetRouter:
             )
         self.margin = margin
         self.affinity_slack = affinity_slack
-        self._lock = threading.Lock()
+        self._lock = concurrency.make_lock("serve.router")
         self._rr = 0
         # last routing decision, for the fleet report / debugging:
         # {"replica", "eta_s", "affinity"}
@@ -151,9 +152,12 @@ class FleetRouter:
             n = len(candidates)
             order = [candidates[(start + i) % n] for i in range(n)]
             chosen, chosen_eta = min(order, key=lambda ve: ve[1])
-        self.last_decision = {
-            "replica": chosen.replica,
-            "eta_s": chosen_eta,
-            "affinity": affinity,
-        }
+        # written under the lock so a fleet report never sees a decision
+        # dict mid-swap relative to the round-robin state it paired with
+        with self._lock:
+            self.last_decision = {
+                "replica": chosen.replica,
+                "eta_s": chosen_eta,
+                "affinity": affinity,
+            }
         return chosen

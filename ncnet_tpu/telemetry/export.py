@@ -103,7 +103,7 @@ class JsonlWriter:
         with self._lock:
             self._flush_locked()
 
-    def _flush_locked(self):
+    def _flush_locked(self):  # guarded-by: _lock
         if not self._pending:
             return
         blob = b"".join(self._pending)

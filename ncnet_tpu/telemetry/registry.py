@@ -27,6 +27,8 @@ import math
 import re
 import threading
 
+from ncnet_tpu.analysis import concurrency
+
 # Upper bounds in seconds for request/step latencies: sub-ms host work up
 # through multi-second cold paths. The +Inf bucket is implicit.
 DEFAULT_LATENCY_BUCKETS = (
@@ -90,10 +92,12 @@ class Counter:
 
     @property
     def value(self):
-        return self._value
+        with self._lock:
+            return self._value
 
     def snapshot(self):
-        return {"kind": self.kind, "value": self._value}
+        with self._lock:
+            return {"kind": self.kind, "value": self._value}
 
 
 class Gauge:
@@ -168,11 +172,13 @@ class Histogram:
 
     @property
     def count(self):
-        return sum(self._counts)
+        with self._lock:
+            return sum(self._counts)
 
     @property
     def sum(self):
-        return self._sum
+        with self._lock:
+            return self._sum
 
     @property
     def samples(self):
@@ -220,7 +226,7 @@ class MetricsRegistry:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = concurrency.make_lock("telemetry.registry")
         self._metrics = {}
 
     def _get_or_create(self, cls, name, help, **kwargs):
@@ -248,21 +254,29 @@ class MetricsRegistry:
         return self._get_or_create(Histogram, name, help, buckets=buckets)
 
     def get(self, name):
-        return self._metrics.get(name)
+        with self._lock:
+            return self._metrics.get(name)
 
     def names(self):
         with self._lock:
             return sorted(self._metrics)
 
+    def _items(self):
+        """Name-sorted ``(name, metric)`` pairs copied under the lock, so
+        iteration never races a concurrent registration; each metric's
+        own snapshot then locks itself OUTSIDE the registry lock (no
+        nesting)."""
+        with self._lock:
+            return sorted(self._metrics.items())
+
     def snapshot(self):
         """``{name: metric.snapshot()}`` for every registered metric."""
-        return {name: self._metrics[name].snapshot() for name in self.names()}
+        return {name: m.snapshot() for name, m in self._items()}
 
     def to_prometheus(self):
         """Prometheus text exposition (version 0.0.4) of the registry."""
         lines = []
-        for name in self.names():
-            m = self._metrics[name]
+        for name, m in self._items():
             if m.help:
                 lines.append(f"# HELP {name} {m.help}")
             lines.append(f"# TYPE {name} {m.kind}")

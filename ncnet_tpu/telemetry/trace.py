@@ -98,7 +98,7 @@ class Tracer:
         self._perf0 = time.perf_counter()
 
     def span(self, name):
-        if not self._enabled:
+        if not self._enabled:  # nclint: disable=unguarded-shared-state -- the "disabled is free" contract: one racy bool read IS the hot path; a span that races enable() is simply attributed to the old state
             return _NULL_SPAN
         return _Span(self, name)
 
@@ -120,7 +120,7 @@ class Tracer:
         self._local.tags = None
 
     def is_enabled(self):
-        return self._enabled
+        return self._enabled  # nclint: disable=unguarded-shared-state -- benign racy read of the enable flag; callers use it as a hint, never for mutual exclusion
 
     def enable(self, sink=None):
         """Turn tracing on. ``sink(event)`` receives each completed span;
@@ -151,7 +151,7 @@ class Tracer:
         return stack
 
     def _emit(self, event):
-        sink = self._sink
+        sink = self._sink  # nclint: disable=unguarded-shared-state -- single racy snapshot of the sink reference: a span completing across disable() delivers to the old sink or the buffer, both safe; locking every emit would serialize all traced threads
         if sink is not None:
             sink(event)
         else:

@@ -1,0 +1,149 @@
+"""`lock_drill` — the runtime concurrency audit's CI gate.
+
+The dynamic counterpart of the `unguarded-shared-state` static rules:
+enables the `OrderedLock` audit (`ncnet_tpu.analysis.concurrency`),
+installs a seeded `ScheduleFuzzer`, and drives a 3-replica CPU toy
+fleet through the PR-11 chaos scenario — replica kill mid-load,
+quarantine, rejoin, more traffic, close — while every serve-layer lock
+records its acquisition graph. Exit status is 0 only when the observed
+graph has no lock-order cycle and no unsuppressed finding at or above
+``--fail-on`` remains; the CI gate is simply
+
+    JAX_PLATFORMS=cpu python scripts/lock_drill.py
+
+Output defaults to a human report (per-lock held-time stats, edges,
+findings); with ``--format json|sarif`` it shares the `Finding` schema
+nclint and `scripts/audit.py` emit, so one consumer handles all three
+analyzers.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from ncnet_tpu.analysis import concurrency  # noqa: E402
+from ncnet_tpu.analysis.findings import (  # noqa: E402
+    SEVERITY_ORDER,
+    format_json,
+    format_sarif,
+    format_text,
+)
+
+
+def run_drill(submits=60, kill_at=10, seed=1311, fuzz_p=0.25):
+    """Kill/rejoin chaos drill on a toy CPU fleet with the lock audit
+    live. Returns the number of resolved futures (all submits must
+    settle — lost requests fail the drill before any lock finding)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ncnet_tpu.resilience import faultinject
+    from ncnet_tpu.serve.fleet import ServeFleet
+    from ncnet_tpu.serve.resilience import ReplicaDown
+
+    params = {"w": jnp.asarray(3.0, jnp.float32)}
+    key = ("k", 2)
+    spec = {"x": ((2,), np.float32)}
+
+    def apply_fn(p, batch):
+        return {"y": batch["x"] * p["w"]}
+
+    resolved = 0
+    with concurrency.ScheduleFuzzer(seed=seed, p=fuzz_p, max_sleep_s=5e-5):
+        fleet = ServeFleet(
+            apply_fn, params, replicas=3, max_batch=4, max_wait=0.002,
+        )
+        try:
+            fleet.warmup([(key, spec)])
+            faultinject.inject("serve.replica.kill", "crash", at=kill_at)
+            futs = [
+                fleet.submit(
+                    key=key,
+                    payload={"x": np.full((2,), float(i), np.float32)},
+                )
+                for i in range(submits)
+            ]
+            for f in futs:
+                try:
+                    f.result(timeout=30)
+                except ReplicaDown as exc:
+                    if not exc.dispatched:
+                        raise
+                resolved += 1
+            faultinject.clear()
+            for rid in fleet.quarantined_ids():
+                fleet.rejoin(rid)
+            post = [
+                fleet.submit(
+                    key=key,
+                    payload={"x": np.full((2,), float(i), np.float32)},
+                )
+                for i in range(submits // 3)
+            ]
+            for f in post:
+                f.result(timeout=30)
+                resolved += 1
+        finally:
+            faultinject.clear()
+            fleet.close()
+    return resolved
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="lock_drill",
+        description="chaos drill under the runtime lock audit (rule "
+                    "catalog: ncnet_tpu/analysis/README.md)",
+    )
+    p.add_argument("--format", choices=["text", "json", "sarif"],
+                   default="text", dest="fmt",
+                   help="output format (default: human report)")
+    p.add_argument("--fail-on", choices=sorted(SEVERITY_ORDER),
+                   default="error",
+                   help="lowest severity that fails the run (default: "
+                        "error — held-time outliers on a fuzzed CPU "
+                        "drill are advisory)")
+    p.add_argument("--seed", type=int, default=1311,
+                   help="ScheduleFuzzer seed (default: 1311)")
+    p.add_argument("--submits", type=int, default=60,
+                   help="requests before the rejoin phase (default: 60)")
+    args = p.parse_args(argv)
+
+    concurrency.clear()
+    concurrency.enable()
+    resolved = run_drill(submits=args.submits, seed=args.seed)
+    findings = concurrency.lock_findings()
+    rep = concurrency.report()
+
+    if args.fmt == "json":
+        print(format_json(findings, tool="lock_drill"))
+    elif args.fmt == "sarif":
+        print(format_sarif(
+            findings, "lock-audit", concurrency.runtime_rules_meta()
+        ))
+    else:
+        print(f"lock drill: {resolved} request(s) resolved, "
+              f"{len(rep['locks'])} audited lock(s), "
+              f"{len(rep['edges'])} acquisition edge(s)")
+        for name in sorted(rep["locks"]):
+            s = rep["locks"][name]
+            print(f"  {name}: {s['acquires']} acquires, "
+                  f"max held {s['max_held_s'] * 1e3:.3f} ms")
+        if rep["cycles"]:
+            for cyc in rep["cycles"]:
+                print(f"  CYCLE: {' -> '.join(cyc + cyc[:1])}")
+        print()
+        print(format_text(findings))
+    threshold = SEVERITY_ORDER[args.fail_on]
+    gating = [f for f in findings if SEVERITY_ORDER[f.severity] >= threshold]
+    return 1 if gating else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,403 @@
+"""Concurrency audit runtime prong (PR 16): `OrderedLock` acquisition-
+graph recording + cycle detection, the `ScheduleFuzzer` interleaving
+explorer, the `make_lock` disabled-is-bare contract, the thread-ledger
+hygiene of engine/fleet shutdown, and the deterministic replay of the
+PR-11 `MicroBatcher` lost-request scenario under schedule perturbation.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ncnet_tpu.analysis import concurrency
+from ncnet_tpu.analysis.findings import format_sarif
+from ncnet_tpu.resilience import faultinject
+from ncnet_tpu.serve.batcher import MicroBatcher, Request
+from ncnet_tpu.serve.engine import ServeEngine
+from ncnet_tpu.serve.fleet import ServeFleet
+from ncnet_tpu.serve.resilience import LatencyEstimator, ReplicaDown
+
+
+@pytest.fixture(autouse=True)
+def _clean_audit():
+    concurrency.clear()
+    faultinject.clear()
+    yield
+    concurrency.clear()
+    faultinject.clear()
+
+
+TOY_PARAMS = {"w": jnp.asarray(3.0, jnp.float32)}
+KEY = ("k", 2)
+SPEC = {"x": ((2,), np.float32)}
+
+
+def _toy_apply(p, batch):
+    return {"y": batch["x"] * p["w"]}
+
+
+def _toy_payload(n, fill):
+    return {"x": np.full((n,), fill, np.float32)}
+
+
+# ----------------------------------------------------------------------
+# make_lock: disabled is a BARE lock, enabled is instrumented
+
+
+def test_make_lock_disabled_returns_bare_lock():
+    lk = concurrency.make_lock("t.plain")
+    rk = concurrency.make_lock("t.reentrant", reentrant=True)
+    assert type(lk) is type(threading.Lock())
+    assert type(rk) is type(threading.RLock())
+    # and using them records NOTHING
+    with lk:
+        pass
+    assert concurrency.acquisition_edges() == {}
+    assert concurrency.held_stats() == {}
+
+
+def test_make_lock_enabled_returns_ordered_lock():
+    concurrency.enable()
+    lk = concurrency.make_lock("t.audited")
+    assert isinstance(lk, concurrency.OrderedLock)
+    with lk:
+        pass
+    assert concurrency.held_stats()["t.audited"]["acquires"] == 1
+
+
+def test_clear_beats_stale_env(monkeypatch):
+    monkeypatch.setenv(concurrency.ENV_VAR, "1")
+    concurrency.clear()  # clear() pins the env as loaded+disabled
+    assert not concurrency.is_enabled()
+    assert type(concurrency.make_lock("t.x")) is type(threading.Lock())
+
+
+def test_env_var_enables(monkeypatch):
+    monkeypatch.setenv(concurrency.ENV_VAR, "1")
+    concurrency.clear()
+    concurrency._env_loaded = False  # simulate a fresh process
+    assert concurrency.is_enabled()
+    assert isinstance(
+        concurrency.make_lock("t.env"), concurrency.OrderedLock
+    )
+
+
+# ----------------------------------------------------------------------
+# the injected lock-order-inversion drill (the acceptance golden test)
+
+
+def test_injected_inversion_names_the_exact_two_lock_cycle():
+    concurrency.enable()
+    a = concurrency.make_lock("drill.A")
+    b = concurrency.make_lock("drill.B")
+
+    def a_then_b():
+        for _ in range(25):
+            with a:
+                with b:
+                    pass
+
+    def b_then_a():
+        for _ in range(25):
+            with b:
+                with a:
+                    pass
+
+    # run SEQUENTIALLY: both orders are recorded (the hazard) without
+    # ever risking the actual deadlock in the test process
+    for fn in (a_then_b, b_then_a):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+
+    assert concurrency.find_cycles() == [["drill.A", "drill.B"]]
+    findings = concurrency.lock_findings()
+    cyc = [f for f in findings if f.rule == "lock-order-cycle"]
+    assert len(cyc) == 1
+    assert cyc[0].severity == "error"
+    assert "drill.A -> drill.B -> drill.A" in cyc[0].message
+    assert cyc[0].detail["cycle"] == ["drill.A", "drill.B"]
+    # and the finding rides the shared SARIF pipeline like every rule
+    doc = json.loads(format_sarif(
+        findings, "lock-audit", concurrency.runtime_rules_meta()
+    ))
+    results = doc["runs"][0]["results"]
+    assert any(r["ruleId"] == "lock-order-cycle" for r in results)
+
+
+def test_consistent_order_has_no_cycle():
+    concurrency.enable()
+    a = concurrency.make_lock("ord.A")
+    b = concurrency.make_lock("ord.B")
+    for _ in range(25):
+        with a:
+            with b:
+                pass
+    assert concurrency.find_cycles() == []
+    assert ("ord.A", "ord.B") in concurrency.acquisition_edges()
+    assert concurrency.lock_findings() == []
+
+
+def test_reentrant_reacquire_adds_no_self_edge():
+    concurrency.enable()
+    r = concurrency.make_lock("re.R", reentrant=True)
+    with r:
+        with r:
+            pass
+    assert concurrency.acquisition_edges() == {}
+    assert concurrency.held_stats()["re.R"]["acquires"] == 2
+
+
+def test_held_time_outlier_finding():
+    concurrency.enable(held_outlier_s=0.01)
+    lk = concurrency.make_lock("slow.L")
+    with lk:
+        time.sleep(0.03)
+    fs = [
+        f for f in concurrency.lock_findings()
+        if f.rule == "lock-held-outlier"
+    ]
+    assert len(fs) == 1
+    assert fs[0].path == "lock:slow.L"
+    assert fs[0].severity == "warning"
+    assert fs[0].detail["held_s"] > 0.01
+
+
+def test_outlier_findings_capped_per_lock():
+    concurrency.enable(held_outlier_s=0.001)
+    lk = concurrency.make_lock("spam.L")
+    for _ in range(10):
+        with lk:
+            time.sleep(0.002)
+    fs = [
+        f for f in concurrency.lock_findings()
+        if f.rule == "lock-held-outlier"
+    ]
+    assert len(fs) == concurrency._OUTLIER_CAP_PER_LOCK
+
+
+def test_report_shape():
+    concurrency.enable()
+    lk = concurrency.make_lock("rep.L")
+    with lk:
+        pass
+    rep = concurrency.report()
+    assert rep["enabled"] is True
+    assert rep["locks"]["rep.L"]["acquires"] == 1
+    assert rep["cycles"] == []
+    assert rep["findings"] == []
+
+
+# ----------------------------------------------------------------------
+# ScheduleFuzzer
+
+
+def test_fuzzer_install_uninstall():
+    fz = concurrency.ScheduleFuzzer(seed=3)
+    with fz:
+        assert concurrency._fuzzer is fz
+    assert concurrency._fuzzer is None
+    # a foreign uninstall must not clobber another fuzzer
+    a, b = concurrency.ScheduleFuzzer(1), concurrency.ScheduleFuzzer(2)
+    a.install()
+    b.uninstall()
+    assert concurrency._fuzzer is a
+    a.uninstall()
+
+
+def test_fuzzer_yields_are_seeded_per_thread():
+    fz = concurrency.ScheduleFuzzer(seed=11, p=1.0, max_sleep_s=1e-5)
+    draws = {}
+
+    def run(tag):
+        rng = fz._rng()
+        draws[tag] = [rng.random() for _ in range(4)]
+
+    t1 = threading.Thread(target=run, args=("a",))
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=run, args=("b",))
+    t2.start()
+    t2.join()
+    # distinct per-thread streams, each deterministic in (seed, arrival)
+    assert draws["a"] != draws["b"]
+    import random as _random
+
+    ref = _random.Random(11 * 1_000_003 + 0)
+    assert draws["a"] == [ref.random() for _ in range(4)]
+
+
+# ----------------------------------------------------------------------
+# the PR-11 MicroBatcher lost-request scenario, fuzzed (satellite 2)
+
+
+def test_microbatcher_lost_request_fuzzed_replay():
+    """PR 11's bug: with max_batch=1 a fresh at-cap group was PARKED
+    instead of flushed; a racing same-key add then grew it past
+    batch_sizes[-1] and the request hung forever. The fix flushes
+    immediately. Replay the race through the ScheduleFuzzer with a
+    pinned seed: two threads hammer the same key with max_batch=1 while
+    seeded yields perturb the interleaving at every lock boundary —
+    every request must come back exactly once, in a size-1 batch."""
+    concurrency.enable()
+    with concurrency.ScheduleFuzzer(seed=1107, p=0.5, max_sleep_s=5e-5):
+        mb = MicroBatcher(max_batch=1, max_wait=0.001)  # audited lock
+        out_lock = threading.Lock()
+        batches = []
+
+        def hammer(tag):
+            for i in range(100):
+                fut = object()
+                b = mb.add(Request(KEY, {"x": (tag, i)}, fut, 0.0, None))
+                if b is not None:
+                    with out_lock:
+                        batches.append(b)
+
+        threads = [
+            threading.Thread(target=hammer, args=(tag,))
+            for tag in ("t1", "t2")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        batches.extend(mb.drain())
+
+    seen = [b.requests[0].payload["x"] for b in batches]
+    assert all(len(b.requests) == 1 for b in batches), (
+        "max_batch=1 group grew past the cap"
+    )
+    assert len(seen) == 200 and len(set(seen)) == 200, (
+        f"lost or duplicated requests: {len(seen)} batches, "
+        f"{len(set(seen))} unique"
+    )
+    # the batcher's single lock cannot deadlock; the audit proves it
+    assert concurrency.find_cycles() == []
+
+
+# ----------------------------------------------------------------------
+# LatencyEstimator EWMA atomicity under hammer (satellite 1)
+
+
+def test_latency_estimator_concurrent_hammer_stays_in_hull():
+    concurrency.enable()
+    with concurrency.ScheduleFuzzer(seed=5, p=0.3, max_sleep_s=2e-5):
+        est = LatencyEstimator(alpha=0.5)  # audited lock
+        lo, hi = 0.010, 0.020
+
+        def observer(seed):
+            for i in range(200):
+                est.observe(KEY, lo if (i + seed) % 2 else hi)
+
+        threads = [
+            threading.Thread(target=observer, args=(s,)) for s in (0, 1, 2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # EWMA of samples within [lo, hi] can never leave the hull —
+        # unless an unlocked read-modify-write tore an update
+        assert lo <= est.estimate(KEY) <= hi
+        assert lo <= est.estimate() <= hi
+    assert concurrency.find_cycles() == []
+
+
+# ----------------------------------------------------------------------
+# thread-ledger hygiene (satellite 3)
+
+
+def test_engine_shutdown_joins_ledger_no_stragglers():
+    eng = ServeEngine(
+        _toy_apply, TOY_PARAMS, max_batch=2, max_wait=0.001,
+        hang_timeout=5.0,
+    )
+    eng.warmup([(KEY, SPEC)])
+    futs = [
+        eng.submit(key=KEY, payload=_toy_payload(2, float(i)))
+        for i in range(8)
+    ]
+    for f in futs:
+        f.result(timeout=10)
+    # a live engine must NOT report its worker pool as stragglers
+    assert eng.report()["straggler_threads"] == []
+    names = sorted(t.name for t in eng._thread_ledger)
+    assert any(n.startswith("serve-prep-") for n in names)
+    assert "serve-readout" in names
+    assert "serve-dispatch-0" in names
+    assert "serve-watchdog" in names
+    eng.close()
+    assert eng.report()["straggler_threads"] == []
+    assert all(not t.is_alive() for t in eng._thread_ledger)
+
+
+def test_fleet_close_joins_ledger_no_stragglers():
+    fleet = ServeFleet(
+        _toy_apply, TOY_PARAMS, replicas=2, replica_hang_timeout=5.0,
+        max_batch=2, max_wait=0.001,
+    )
+    fleet.warmup([(KEY, SPEC)])
+    fleet.submit(key=KEY, payload=_toy_payload(2, 1.0)).result(timeout=10)
+    assert fleet.report()["straggler_threads"] == []
+    names = sorted(t.name for t in fleet._thread_ledger)
+    assert "fleet-requeue" in names
+    assert sum(n == "serve-watchdog" for n in names) == 2
+    fleet.close()
+    assert fleet.report()["straggler_threads"] == []
+    assert all(not t.is_alive() for t in fleet._thread_ledger)
+
+
+# ----------------------------------------------------------------------
+# the audited chaos drill (satellite 5's gate, runnable locally):
+# fleet kill/rejoin under load with every serve lock instrumented and
+# the fuzzer perturbing schedules — no lock-order cycle may appear
+
+
+def test_fleet_chaos_drill_under_lock_audit():
+    concurrency.enable()
+    with concurrency.ScheduleFuzzer(seed=1311, p=0.25, max_sleep_s=5e-5):
+        fleet = ServeFleet(
+            _toy_apply, TOY_PARAMS, replicas=3,
+            max_batch=4, max_wait=0.002,
+        )
+        try:
+            fleet.warmup([(KEY, SPEC)])
+            faultinject.inject("serve.replica.kill", "crash", at=10)
+            futs = [
+                fleet.submit(key=KEY, payload=_toy_payload(2, float(i)))
+                for i in range(60)
+            ]
+            resolved = 0
+            for f in futs:
+                try:
+                    f.result(timeout=10)
+                    resolved += 1
+                except ReplicaDown as exc:
+                    assert exc.dispatched
+                    resolved += 1
+            assert resolved == 60
+            faultinject.clear()
+            dead = fleet.quarantined_ids()
+            if dead:  # the injected kill landed on a routed replica
+                assert fleet.rejoin(dead[0]) > 0
+            for i in range(20):
+                fleet.submit(
+                    key=KEY, payload=_toy_payload(2, float(i))
+                ).result(timeout=10)
+        finally:
+            fleet.close()
+
+    # the drill's gate: schedule exploration surfaced no ordering hazard
+    assert concurrency.find_cycles() == [], concurrency.report()["edges"]
+    gating = [
+        f for f in concurrency.lock_findings() if f.severity == "error"
+    ]
+    assert gating == [], "\n".join(f.format() for f in gating)
+    # the serve locks really were instrumented (the drill is not vacuous)
+    stats = concurrency.held_stats()
+    assert any(name.startswith("serve.") for name in stats)

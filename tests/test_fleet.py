@@ -18,6 +18,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from ncnet_tpu.analysis import concurrency
 from ncnet_tpu.parallel.mesh import make_batch_sharded_apply, make_mesh
 from ncnet_tpu.resilience import faultinject
 from ncnet_tpu.resilience.faultinject import InjectedFault
@@ -51,6 +52,32 @@ def _clean_faults():
     faultinject.clear()
     yield
     faultinject.clear()
+
+
+# decided at IMPORT time from NCNET_LOCK_AUDIT so a plain run stays on
+# bare threading.Lock (zero audit overhead in the tier-1 suite)
+_LOCK_AUDIT = concurrency.is_enabled()
+
+
+@pytest.fixture(autouse=True)
+def _lock_audit_sweep():
+    """Under ``NCNET_LOCK_AUDIT=1`` every fleet test — the chaos drills
+    in particular — doubles as a schedule-exploration run: all serve
+    locks are instrumented, a seeded fuzzer perturbs interleavings, and
+    any observed lock-order cycle fails the test that produced it."""
+    if not _LOCK_AUDIT:
+        yield
+        return
+    concurrency.clear()
+    concurrency.enable()
+    with concurrency.ScheduleFuzzer(seed=1311, p=0.25, max_sleep_s=5e-5):
+        yield
+    cycles = concurrency.find_cycles()
+    assert cycles == [], (
+        f"lock-order cycle(s) under audit: {cycles}\n"
+        + "\n".join(f.format() for f in concurrency.lock_findings())
+    )
+    concurrency.clear()
 
 
 TOY_PARAMS = {"w": jnp.asarray(3.0, jnp.float32)}
