@@ -48,6 +48,13 @@ from ncnet_tpu.serve.buckets import (
 )
 from ncnet_tpu.serve.engine import ServeEngine, make_serve_match_step, payload_spec
 from ncnet_tpu.serve.fleet import ServeFleet
+from ncnet_tpu.serve.http import (
+    HttpFrontDoor,
+    default_bucket_key,
+    make_http_server,
+    outcome_status,
+    start_http_server,
+)
 from ncnet_tpu.serve.resilience import (
     AdmissionRejected,
     DeadlineExceeded,
@@ -69,6 +76,7 @@ __all__ = [
     "BucketSpec",
     "DeadlineExceeded",
     "FleetRouter",
+    "HttpFrontDoor",
     "HysteresisController",
     "LatencyEstimator",
     "MicroBatch",
@@ -84,8 +92,12 @@ __all__ = [
     "StageFailure",
     "Watchdog",
     "default_batch_sizes",
+    "default_bucket_key",
     "drain_on_preemption",
+    "make_http_server",
     "make_serve_match_step",
+    "outcome_status",
+    "start_http_server",
     "pair_bucket",
     "payload_spec",
     "quantized_resize_shape",

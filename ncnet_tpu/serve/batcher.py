@@ -9,11 +9,19 @@ it.
 Policy (the ISSUE's contract):
 
 * requests group by an opaque ``key`` (the `buckets.pair_bucket` of the
-  request; only same-key requests may share a compiled program);
+  request; only same-key requests may share a compiled program) and by
+  their pinned quality ``variant`` (a rung-pinned request must never be
+  coalesced into a batch that will run at a different rung);
 * a group flushes when it reaches ``max_batch`` (cap) or when its OLDEST
   request has waited ``max_wait`` seconds (deadline) — latency is bounded
   by max_wait even at low traffic, and a lone request never waits behind
   a full batch;
+* DEADLINE-AWARE flush (ISSUE 17): when an ``estimate_fn`` is supplied,
+  a group also flushes early once its tightest member's remaining budget
+  drops below ``max_wait`` plus the bucket's EWMA service estimate —
+  waiting any longer would spend batching headroom the request no longer
+  has. Without ``estimate_fn`` the batcher is the fixed-wait baseline
+  (the A/B arm of benchmarks/micro_http.py);
 * each flushed group becomes a :class:`MicroBatch` padded UP to the
   smallest allowed batch size (powers of two by default, so the warmup
   shape set stays small). Padding replicates a real request's arrays and
@@ -63,27 +71,32 @@ class Request:
     future its result resolves. ``t_submit`` feeds latency accounting;
     ``deadline`` (absolute, on the engine clock, None = no SLO) lets the
     pipeline drop the request at any stage once it can no longer be
-    served in time (engine's deadline contract, PR 10)."""
+    served in time (engine's deadline contract, PR 10); ``variant``
+    (None = let the engine's controller choose) pins the quality rung the
+    request must run at (``X-Quality``, ISSUE 17)."""
 
-    __slots__ = ("key", "payload", "future", "t_submit", "deadline")
+    __slots__ = ("key", "payload", "future", "t_submit", "deadline", "variant")
 
-    def __init__(self, key, payload, future, t_submit, deadline=None):
+    def __init__(self, key, payload, future, t_submit, deadline=None, variant=None):
         self.key = key
         self.payload = payload
         self.future = future
         self.t_submit = t_submit
         self.deadline = deadline
+        self.variant = variant
 
 
 @dataclasses.dataclass
 class MicroBatch:
     """A flushed group: ``len(requests)`` real samples to be stacked and
     padded to ``pad_to`` rows (the engine replicates the last real
-    payload into the padding slots and discards them at readout)."""
+    payload into the padding slots and discards them at readout).
+    ``variant`` is the members' pinned rung (None: controller's pick)."""
 
     key: object
     requests: List[Request]
     pad_to: int
+    variant: Optional[str] = None
 
     @property
     def occupancy(self):
@@ -91,8 +104,20 @@ class MicroBatch:
         return len(self.requests) / self.pad_to
 
 
+class _Group:
+    """One open coalescing group: add time of the oldest member, the
+    tightest member deadline (None: no member carries one), requests."""
+
+    __slots__ = ("t0", "deadline", "requests")
+
+    def __init__(self, t0, deadline, requests):
+        self.t0 = t0
+        self.deadline = deadline
+        self.requests = requests
+
+
 class MicroBatcher:
-    """Per-key request coalescing under a deadline and a cap.
+    """Per-(key, variant) request coalescing under a deadline and a cap.
 
     Thread-safe; all methods are non-blocking. ``clock`` must be a
     monotonic ``() -> float`` (seconds); tests pass a fake. The batcher
@@ -101,6 +126,9 @@ class MicroBatcher:
     early — deadlines simply stretch until the clock passes the add
     time again, and `add`'s cap flush and `drain` are clock-independent
     (pinned in tests/test_serve_resilience.py).
+
+    ``estimate_fn(bucket_key) -> Optional[float]`` enables deadline-aware
+    flushing (see module docstring); None disables it (fixed-wait).
     """
 
     def __init__(
@@ -109,6 +137,7 @@ class MicroBatcher:
         max_wait: float = 0.005,
         batch_sizes: Optional[Sequence[int]] = None,
         clock: Callable[[], float] = time.monotonic,
+        estimate_fn: Optional[Callable[[object], Optional[float]]] = None,
     ):
         if max_wait < 0:
             raise ValueError(f"max_wait must be >= 0, got {max_wait}")
@@ -125,80 +154,117 @@ class MicroBatcher:
                 f"max_batch={max_batch} group"
             )
         self._clock = clock
+        self._estimate_fn = estimate_fn
         self._lock = concurrency.make_lock("serve.batcher")
-        # key -> (oldest-add time, [Request, ...]); insertion-ordered so
-        # deadline scans see oldest groups first
+        # (key, variant) -> _Group; insertion-ordered so deadline scans
+        # see oldest groups first
         self._groups = {}
 
-    def _make_batch(self, key, reqs):
-        return MicroBatch(key, reqs, pad_size(len(reqs), self.batch_sizes))
+    @property
+    def deadline_aware(self):
+        """Whether the deadline-aware early-flush policy is active."""
+        return self._estimate_fn is not None
+
+    def _make_batch(self, key, reqs, variant):
+        return MicroBatch(
+            key, reqs, pad_size(len(reqs), self.batch_sizes), variant
+        )
+
+    def _flush_at(self, key, grp):
+        """Absolute time this group should flush: the fixed max_wait
+        deadline, pulled earlier when the tightest member's remaining
+        budget would drop below max_wait + the bucket's service
+        estimate (deadline-aware policy; only with an estimate_fn)."""
+        at = grp.t0 + self.max_wait
+        if grp.deadline is not None and self._estimate_fn is not None:
+            est = self._estimate_fn(key)
+            at = min(at, grp.deadline - self.max_wait - (est or 0.0))
+        return at
 
     def add(self, request: Request) -> Optional[MicroBatch]:
         """Queue a request; returns a full MicroBatch if this add filled
         its group to ``max_batch``, else None."""
+        gkey = (request.key, request.variant)
         with self._lock:
-            entry = self._groups.get(request.key)
-            if entry is None:
+            grp = self._groups.get(gkey)
+            if grp is None:
                 if self.max_batch <= 1:
                     # a fresh group already AT the cap (max_batch=1, the
                     # fleet scaling benchmark's no-coalescing mode) must
                     # flush now: parking it would let the next add grow
                     # the group past batch_sizes[-1]
-                    return self._make_batch(request.key, [request])
-                self._groups[request.key] = (self._clock(), [request])
+                    return self._make_batch(
+                        request.key, [request], request.variant
+                    )
+                self._groups[gkey] = _Group(
+                    self._clock(), request.deadline, [request]
+                )
                 return None
-            entry[1].append(request)
-            if len(entry[1]) >= self.max_batch:
-                del self._groups[request.key]
-                return self._make_batch(request.key, entry[1])
+            grp.requests.append(request)
+            if request.deadline is not None and (
+                grp.deadline is None or request.deadline < grp.deadline
+            ):
+                grp.deadline = request.deadline
+            if len(grp.requests) >= self.max_batch:
+                del self._groups[gkey]
+                return self._make_batch(request.key, grp.requests, request.variant)
             return None
 
     def ready(self, now: Optional[float] = None) -> List[MicroBatch]:
-        """Pop every group whose deadline has expired (oldest request
-        waited >= max_wait). Full groups never sit here — `add` returns
-        them immediately."""
+        """Pop every group whose flush time has arrived (oldest request
+        waited >= max_wait, or — deadline-aware — the tightest member
+        budget no longer covers further waiting). Full groups never sit
+        here — `add` returns them immediately."""
         if now is None:
             now = self._clock()
         out = []
         with self._lock:
             expired = [
-                key
-                for key, (t0, _) in self._groups.items()
-                if now - t0 >= self.max_wait
+                gkey
+                for gkey, grp in self._groups.items()
+                if now >= self._flush_at(gkey[0], grp)
             ]
-            for key in expired:
-                _, reqs = self._groups.pop(key)
-                out.append(self._make_batch(key, reqs))
+            for gkey in expired:
+                grp = self._groups.pop(gkey)
+                out.append(self._make_batch(gkey[0], grp.requests, gkey[1]))
         return out
 
     def drain(self) -> List[MicroBatch]:
         """Pop everything regardless of deadline (shutdown flush)."""
         out = []
         with self._lock:
-            for key, (_, reqs) in self._groups.items():
-                out.append(self._make_batch(key, reqs))
+            for gkey, grp in self._groups.items():
+                out.append(self._make_batch(gkey[0], grp.requests, gkey[1]))
             self._groups.clear()
         return out
 
     def next_deadline(self, now: Optional[float] = None) -> Optional[float]:
-        """Seconds until the oldest pending group expires (<= 0: already
-        expired), or None when empty — the dispatcher's wait timeout."""
+        """Seconds until the next pending group flushes (<= 0: already
+        due), or None when empty — the dispatcher's wait timeout. This
+        is the EARLIEST of each group's fixed max_wait deadline and its
+        deadline-aware pull-forward, so the dispatcher wakes in time for
+        tight budgets instead of sleeping through them (ISSUE 17's
+        batcher/engine seam fix)."""
         if now is None:
             now = self._clock()
         with self._lock:
             if not self._groups:
                 return None
-            t0 = min(t for t, _ in self._groups.values())
-        return (t0 + self.max_wait) - now
+            at = min(
+                self._flush_at(gkey[0], grp)
+                for gkey, grp in self._groups.items()
+            )
+        return at - now
 
     def pending(self) -> int:
         """Number of queued (not yet flushed) requests."""
         with self._lock:
-            return sum(len(reqs) for _, reqs in self._groups.values())
+            return sum(len(grp.requests) for grp in self._groups.values())
 
     def keys(self):
         """Bucket keys with queued (not yet flushed) requests — the
         fleet router's bucket-affinity signal: a replica already holding
-        half a batch of key K is the cheapest place to send one more K."""
+        half a batch of key K is the cheapest place to send one more K.
+        Deduplicated across variants (affinity is per compiled bucket)."""
         with self._lock:
-            return tuple(self._groups)
+            return tuple(dict.fromkeys(gkey[0] for gkey in self._groups))

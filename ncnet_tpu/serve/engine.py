@@ -196,6 +196,17 @@ class ServeEngine:
       reads as a hang; None (default) disables it.
     * ``deadline_margin`` — safety factor on the EWMA latency estimate
       admission control sheds against.
+    * ``deadline_flush`` (default True) — deadline-aware micro-batch
+      flushing: a group flushes early once its tightest member's
+      remaining budget drops below ``max_wait`` plus the bucket's EWMA
+      service estimate. False restores the fixed-wait policy (the
+      goodput A/B baseline in benchmarks/micro_http.py).
+    * ``per_bucket_quality`` — cost-aware per-bucket degradation: each
+      bucket gets its own `QualityLadder` fed (dispatch ETA / tightest
+      queued budget), so rung choice tracks each bucket's own cost
+      instead of one global queue signal; ``bucket_ladder=`` injects a
+      custom ladder factory. Per-request ``submit(variant=)`` pins
+      override any controller.
     * ``clock`` — injectable monotonic clock shared with the batcher
       (tests pass a fake).
 
@@ -253,6 +264,9 @@ class ServeEngine:
         refined_apply_fn=None,
         quality_controller=None,
         deadline_margin=1.0,
+        deadline_flush=True,
+        per_bucket_quality=False,
+        bucket_ladder=None,
         hang_timeout=None,
         estimator=None,
         clock=time.monotonic,
@@ -297,12 +311,18 @@ class ServeEngine:
             if batch_sizes is not None
             else default_batch_sizes(max_batch)
         )
+        self.estimator = (
+            estimator if estimator is not None else LatencyEstimator()
+        )
+        # deadline-aware flush (ISSUE 17): the batcher pulls a group's
+        # flush forward once its tightest member's remaining budget drops
+        # below max_wait + the bucket's EWMA service estimate. OFF
+        # (deadline_flush=False) is the fixed-wait baseline arm of
+        # benchmarks/micro_http.py's goodput A/B.
         self._batcher = MicroBatcher(
             max_batch=max_batch, max_wait=max_wait,
             batch_sizes=self.batch_sizes, clock=clock,
-        )
-        self.estimator = (
-            estimator if estimator is not None else LatencyEstimator()
+            estimate_fn=(self.estimator.estimate if deadline_flush else None),
         )
 
         # one jit wrapper per program variant (standard, plus degraded
@@ -374,7 +394,23 @@ class ServeEngine:
             self.controller = HysteresisController()
         else:
             self.controller = None
-        # lock-order: _close_lock -> _gen_lock -> _compile_lock -> _pending_lock
+        # per-bucket cost-aware degradation (ISSUE 17): one QualityLadder
+        # PER BUCKET, fed the ratio of the bucket's dispatch ETA
+        # (max_wait + EWMA estimate) to the tightest queued budget, so a
+        # heavy bucket can step down a rung while a light one stays rich.
+        # Effective only when a cheaper/richer program exists; the global
+        # controller then becomes the no-deadline fallback signal only.
+        self._per_bucket = bool(per_bucket_quality) and (
+            self._jit_degraded is not None or self._jit_refined is not None
+        )
+        self._bucket_ladder_fn = (
+            bucket_ladder
+            if bucket_ladder is not None
+            else self._default_bucket_ladder
+        )
+        self._bucket_ladders = {}
+        self._bucket_lock = concurrency.make_lock("serve.engine.buckets")
+        # lock-order: _close_lock -> _gen_lock -> _compile_lock -> _bucket_lock -> _pending_lock
         # (no pair is ever truly nested today; the declared order is the
         # one any future nesting must follow, and the NCNET_LOCK_AUDIT=1
         # drills verify the observed graph stays acyclic)
@@ -438,6 +474,10 @@ class ServeEngine:
         self._m_rejected = m.counter(
             "serve_admission_rejected_total",
             "submits refused on a full queue (AdmissionRejected)",
+        )
+        self._m_pinned = m.counter(
+            "serve_requests_pinned_total",
+            "requests submitted with a pinned quality variant",
         )
         self._m_batches = m.counter(
             "serve_batches_total", "device batches dispatched"
@@ -675,8 +715,28 @@ class ServeEngine:
     # ------------------------------------------------------------------
     # request path
 
+    def _check_variant(self, variant):
+        """Validate a per-request quality pin against the programs this
+        engine actually warmed — a typo or an unservable rung must fail
+        at submit time (HTTP 400), never mid-dispatch."""
+        jits = {
+            "standard": self._jit,
+            "degraded": self._jit_degraded,
+            "refined": self._jit_refined,
+        }
+        if variant not in jits:
+            raise ValueError(
+                f"unknown quality variant {variant!r} "
+                f"(expected one of {sorted(jits)})"
+            )
+        if jits[variant] is None:
+            raise ValueError(
+                f"variant {variant!r} pinned but the engine has no "
+                f"{variant} program configured"
+            )
+
     def submit(self, raw=None, *, key=None, payload=None, timeout=None,
-               deadline_s=None):
+               deadline_s=None, variant=None):
         """Queue one request; returns a `concurrent.futures.Future`.
 
         With a ``prep_fn``: pass ``raw`` (whatever the prep fn consumes).
@@ -693,7 +753,16 @@ class ServeEngine:
         a `RequestShed` (no queue slot occupied, counted in
         ``serve_requests_shed_total``). An accepted request whose
         deadline expires in-pipeline resolves with `DeadlineExceeded`.
+
+        ``variant`` pins the quality rung ("refined" / "standard" /
+        "degraded") this request must be served at (the ``X-Quality``
+        header contract): it bypasses the degradation controller, joins
+        only same-rung micro-batches, and raises `ValueError` at submit
+        when the engine has no such program. None (default) lets the
+        controller choose.
         """
+        if variant is not None:
+            self._check_variant(variant)
         if self._closed:  # nclint: disable=unguarded-shared-state -- benign racy read of the monotonic close flag: kill() holds _close_lock across the drain wait, so a locked read here would block every submitter for a full drain
             raise RuntimeError("submit on a closed ServeEngine")
         if raw is None:
@@ -709,10 +778,15 @@ class ServeEngine:
         if deadline is not None:
             est = self.estimator.estimate(key)
             if est is not None:
-                eta = (
-                    self._batcher.max_wait
-                    + est * self._deadline_margin
+                # the fixed-wait batcher makes a tight request pay up to
+                # max_wait of coalescing before service; the deadline-
+                # aware batcher flushes a tight group early, so charging
+                # max_wait here would shed requests it CAN serve
+                wait = (
+                    0.0 if self._batcher.deadline_aware
+                    else self._batcher.max_wait
                 )
+                eta = wait + est * self._deadline_margin
                 if now + eta > deadline:
                     # shed BEFORE occupying a queue slot: the future is
                     # returned pre-resolved with the typed shed
@@ -729,7 +803,7 @@ class ServeEngine:
                         ),
                     )
                     return fut
-        item = (raw, fut, now, deadline)
+        item = (raw, fut, now, deadline, variant)
         try:
             if timeout == 0:
                 self._submit_q.put_nowait(item)
@@ -746,6 +820,8 @@ class ServeEngine:
             ) from None
         self._track(fut)
         self._m_submitted.inc()
+        if variant is not None:
+            self._m_pinned.inc()
         return fut
 
     # -- prep stage ----------------------------------------------------
@@ -777,7 +853,7 @@ class ServeEngine:
             item = self._submit_q.get()
             if item is _SENTINEL:
                 return
-            raw, fut, t_submit, deadline = item
+            raw, fut, t_submit, deadline, variant = item
             inflight["fut"] = fut
             # a STAGE crash (vs a request failure below) escapes this
             # loop to the supervisor, which fails only `inflight`
@@ -815,7 +891,7 @@ class ServeEngine:
             # it silently (double-settle is impossible — settling is
             # InvalidStateError-guarded)
             batch = self._batcher.add(
-                Request(key, payload, fut, t_submit, deadline)
+                Request(key, payload, fut, t_submit, deadline, variant)
             )
             if batch is not None:  # the add filled a group to max_batch
                 self._batch_q.put(batch)
@@ -946,9 +1022,18 @@ class ServeEngine:
             return
         if expired:
             batch = MicroBatch(
-                batch.key, live, pad_size(len(live), self.batch_sizes)
+                batch.key, live, pad_size(len(live), self.batch_sizes),
+                batch.variant,
             )
-        variant = self._variant_now()
+        # variant precedence: a pinned batch wins (the members asked for
+        # exactly this rung), then the per-bucket cost-aware ladder, then
+        # the global controller
+        if batch.variant is not None:
+            variant = batch.variant
+        elif self._per_bucket:
+            variant = self._bucket_variant(batch, now)
+        else:
+            variant = self._variant_now()
         # the sharded program is the LARGE-batch fast path for the
         # STANDARD tier only; under pressure the cheaper single-device
         # band program wins, and the refined tier ships as the
@@ -987,6 +1072,61 @@ class ServeEngine:
 
     # -- quality/degradation controller --------------------------------
 
+    def _default_bucket_ladder(self):
+        """A fresh per-bucket ladder over exactly the rungs this engine
+        can serve. Thresholds are COST-pressure semantics (dispatch ETA /
+        remaining budget): >= 1.0 sustained means the bucket is missing
+        its budgets — step down a rung immediately (up_count=1, a missed
+        SLO should not need two batches of proof); <= 0.5 sustained
+        means the budget covers twice the ETA — re-earn richer quality
+        after two comfortable batches."""
+        rungs = []
+        if self._jit_refined is not None:
+            rungs.append("refined")
+        rungs.append("standard")
+        if self._jit_degraded is not None:
+            rungs.append("degraded")
+        return QualityLadder(
+            rungs=tuple(rungs), start="standard",
+            high=1.0, low=0.5, up_count=1, down_count=2,
+        )
+
+    def _bucket_variant(self, batch, now):
+        """Per-bucket cost-aware rung pick (ISSUE 17): feed this bucket's
+        ladder the ratio of its dispatch ETA (batcher wait + EWMA
+        service estimate) to the tightest remaining budget in the batch.
+        Requests without deadlines (or a cold estimator) fall back to
+        the global queued-work fraction — the same signal the global
+        controller uses."""
+        est = self.estimator.estimate(batch.key)
+        deadlines = [
+            r.deadline for r in batch.requests if r.deadline is not None
+        ]
+        if est is not None and deadlines:
+            remaining = min(deadlines) - now
+            eta = self._batcher.max_wait + est * self._deadline_margin
+            # expired budgets were already dropped above; clamp anyway
+            pressure = min(eta / max(remaining, 1e-6), 1e6)
+        else:
+            pressure = self.queued_work() / max(1, self._queue_limit)
+        with self._bucket_lock:
+            ladder = self._bucket_ladders.get(batch.key)
+            if ladder is None:
+                ladder = self._bucket_ladder_fn()
+                self._bucket_ladders[batch.key] = ladder
+            was = ladder.variant
+            ladder.update(pressure)
+            variant = ladder.variant
+        if variant != was:
+            self._m_flips.inc()
+        # a custom bucket_ladder factory may name rungs this engine
+        # lacks; clamp like _variant_now rather than crash mid-dispatch
+        if variant == "degraded" and self._jit_degraded is None:
+            return "standard"
+        if variant == "refined" and self._jit_refined is None:
+            return "standard"
+        return variant
+
     def _variant_now(self):
         """The program variant dispatch uses RIGHT NOW. Clamps a rung the
         engine cannot serve (controller says refined/degraded but no such
@@ -1010,6 +1150,10 @@ class ServeEngine:
         if self.controller is None or (
             self._jit_degraded is None and self._jit_refined is None
         ):
+            return
+        if self._per_bucket:
+            # rung choice happens per batch in _bucket_variant; driving
+            # the global controller too would double-count flips
             return
         pressure = (
             self._submit_q.qsize()
@@ -1270,6 +1414,8 @@ class ServeEngine:
             "degrade_flips": self._m_flips.value,
             "degraded_mode": self._degraded_now(),
             "quality_variant": self._variant_now(),
+            "pinned": self._m_pinned.value,
+            "deadline_flush": self._batcher.deadline_aware,
             "dispatch_hangs": self._m_hangs.value,
             "stage_restarts": {
                 "prep": self._m_prep_restarts.value,
@@ -1277,6 +1423,13 @@ class ServeEngine:
                 "readout": self._m_readout_restarts.value,
             },
         }
+        with self._bucket_lock:
+            # str() keys: bucket keys are tuples and the report must
+            # stay json.dumps-able (scripts/serve*.py print it)
+            s["bucket_quality"] = {
+                str(key): ladder.variant
+                for key, ladder in self._bucket_ladders.items()
+            }
         s["mean_occupancy"] = self._mean_occupancy()
         s["compiles"] = self._trace_count
         with self._compile_lock:

@@ -64,14 +64,15 @@ class _Request:
     ``requeued_then_completed``, keeping the accounting identity exact."""
 
     __slots__ = ("future", "raw", "key", "payload", "deadline_abs",
-                 "requeued")
+                 "variant", "requeued")
 
-    def __init__(self, raw, key, payload, deadline_abs):
+    def __init__(self, raw, key, payload, deadline_abs, variant=None):
         self.future = Future()
         self.raw = raw
         self.key = key
         self.payload = payload
         self.deadline_abs = deadline_abs
+        self.variant = variant
         self.requeued = False
 
 
@@ -120,6 +121,14 @@ class ServeFleet:
         self._params = params
         self._engine_kwargs = dict(engine_kwargs)
         self._router = router if router is not None else FleetRouter()
+        # the rungs every replica can serve — submit() validates a
+        # variant pin here so a bad pin raises synchronously instead of
+        # bouncing a typed failure off whichever replica routing picked
+        self._variants = (
+            ("refined",) if engine_kwargs.get("refined_apply_fn") else ()
+        ) + ("standard",) + (
+            ("degraded",) if engine_kwargs.get("degraded_apply_fn") else ()
+        )
         self._hang_timeout = replica_hang_timeout
         self._clock = clock
         self._closed = False
@@ -290,21 +299,28 @@ class ServeFleet:
 
     # -- submit / dispatch ---------------------------------------------
 
-    def submit(self, raw=None, *, key=None, payload=None, deadline_s=None):
+    def submit(self, raw=None, *, key=None, payload=None, deadline_s=None,
+               variant=None):
         """Queue one request on the best replica; returns a Future.
 
         The fleet analog of `ServeEngine.submit`: same raw-vs-
         key/payload convention, same typed outcomes — plus `ReplicaDown`
         (``dispatched=True``) when the replica holding a dispatched
-        batch dies. Routing failures resolve the RETURNED future (typed
-        `RequestShed`), they do not raise, so callers have exactly one
-        error channel."""
+        batch dies. ``variant`` pins the quality rung fleet-wide (the
+        pin survives a requeue onto a survivor). Routing failures
+        resolve the RETURNED future (typed `RequestShed`), they do not
+        raise, so callers have exactly one error channel."""
+        if variant is not None and variant not in self._variants:
+            raise ValueError(
+                f"unknown or unservable quality variant {variant!r} "
+                f"(this fleet serves {list(self._variants)})"
+            )
         if self._closed:  # nclint: disable=unguarded-shared-state -- benign racy read of the monotonic close flag: close() settles every pending future after the flip, so a submit that races it still resolves
             raise RuntimeError("submit on a closed ServeFleet")
         deadline_abs = (
             None if deadline_s is None else self._clock() + deadline_s
         )
-        record = _Request(raw, key, payload, deadline_abs)
+        record = _Request(raw, key, payload, deadline_abs, variant)
         with self._pending_lock:
             self._pending.add(record)
         self._m_submitted.inc()
@@ -354,6 +370,7 @@ class ServeFleet:
             inner = engine.submit(
                 record.raw, key=record.key, payload=record.payload,
                 deadline_s=self._remaining(record),
+                variant=record.variant,
             )
         except RuntimeError as exc:
             # includes AdmissionRejected; a closed engine means either a

@@ -1,0 +1,441 @@
+"""HTTP front door: the serving stack's network edge (ISSUE 17).
+
+A thin stdlib-only (``http.server.ThreadingHTTPServer``) layer over
+`ServeEngine.submit` / `ServeFleet.submit` that turns the typed outcome
+hierarchy into WIRE contracts — the whole point of typed outcomes since
+PR 10 was that a load balancer can act on them:
+
+==========================  ======  =======================================
+typed outcome               status  extras
+==========================  ======  =======================================
+result                      200     ``{"result": {name: nested lists}}``
+`AdmissionRejected`         429     ``Retry-After`` from the carried hint
+`RequestShed` (admission)   429     ``Retry-After``, estimate + budget body
+`RequestShed` (drain)       503     server is draining / closed
+`DeadlineExceeded`          504     the FAILING STAGE in the body
+`ReplicaDown`               502     replica + dispatched flag in the body
+`StageFailure` / other      500     stage (+ hang flag) / exception name
+bad request (pin, JSON)     400     `ValueError` detail in the body
+==========================  ======  =======================================
+
+Endpoints:
+
+* ``POST /v1/match`` — body ``{"payload": {name: nested lists},
+  "dtypes": {name: dtype-str} (optional, default float32)}``. Headers:
+  ``X-Deadline-Ms`` propagates INTO the stack as ``deadline_s`` (the
+  engine's admission control, deadline-aware micro-batch flush, and
+  per-bucket cost ladders all run off it); ``X-Quality`` pins the
+  quality rung ("refined" / "standard" / "degraded") for this request.
+* ``GET /healthz`` — 200 while serving; 503 before warmup finishes and
+  from the moment a drain BEGINS (the load balancer stops routing
+  before SIGTERM completes), while the listener keeps answering.
+* ``GET /metrics`` — the registry's Prometheus text snapshot.
+
+Every response carries exactly one status code counted in
+``http_responses_<code>_total``, so the engine/fleet accounting identity
+can be reconciled against the HTTP tallies (benchmarks/micro_http.py).
+
+The front door never blocks on a full submit queue (engine submits use
+``timeout=0``): backpressure surfaces as 429, not as a wedged handler
+thread holding a socket open.
+"""
+
+import inspect
+import json
+import math
+import threading
+import time
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ncnet_tpu.analysis import concurrency
+from ncnet_tpu.serve.resilience import (
+    AdmissionRejected,
+    DeadlineExceeded,
+    ReplicaDown,
+    RequestShed,
+    StageFailure,
+)
+from ncnet_tpu.telemetry import trace
+from ncnet_tpu.telemetry.registry import MetricsRegistry
+
+#: status codes pre-registered as counters (anything else falls into
+#: http_responses_other_total; the registry has no labels by design)
+_KNOWN_CODES = (200, 400, 404, 405, 429, 500, 502, 503, 504)
+
+VALID_QUALITY = ("refined", "standard", "degraded")
+
+
+def default_bucket_key(payload):
+    """Canonical bucket key of a payload: the sorted (name, shape,
+    dtype) spec tuple. Server-side and deterministic — the SAME function
+    keys warmup and live traffic, so a warmed shape can never miss its
+    executable because a client spelled the key differently."""
+    return tuple(
+        sorted(
+            (name, tuple(np.shape(arr)), str(np.asarray(arr).dtype))
+            for name, arr in payload.items()
+        )
+    )
+
+
+def decode_payload(obj, dtypes=None):
+    """JSON body -> ``{name: np.ndarray}``. Arrays default to float32
+    (JSON floats would otherwise decode as float64 and miss every warmed
+    float32 bucket); ``dtypes`` overrides per name."""
+    if not isinstance(obj, dict) or not obj:
+        raise ValueError("payload must be a non-empty JSON object")
+    dtypes = dtypes or {}
+    out = {}
+    for name, val in obj.items():
+        dt = np.dtype(dtypes.get(name, "float32"))
+        out[name] = np.asarray(val, dtype=dt)
+    return out
+
+
+def outcome_status(exc):
+    """Map a typed serving outcome to ``(status, retry_after_s, body)``.
+
+    The single source of truth for the wire contract — the table test in
+    tests/test_http.py pins every row."""
+    if isinstance(exc, AdmissionRejected):
+        return 429, exc.retry_after_s, {
+            "error": "admission_rejected",
+            "detail": str(exc),
+        }
+    if isinstance(exc, DeadlineExceeded):
+        return 504, None, {
+            "error": "deadline_exceeded",
+            "stage": exc.stage,
+            "detail": str(exc),
+        }
+    if isinstance(exc, RequestShed):
+        if exc.reason == "drain":
+            return 503, exc.retry_after_s, {
+                "error": "draining",
+                "detail": str(exc),
+            }
+        return 429, exc.retry_after_s, {
+            "error": "shed",
+            "reason": exc.reason,
+            "estimated_s": exc.estimated_s,
+            "deadline_s": exc.deadline_s,
+            "detail": str(exc),
+        }
+    if isinstance(exc, ReplicaDown):
+        return 502, None, {
+            "error": "replica_down",
+            "replica": exc.replica,
+            "dispatched": exc.dispatched,
+            "detail": str(exc),
+        }
+    if isinstance(exc, StageFailure):
+        return 500, None, {
+            "error": "stage_failure",
+            "stage": exc.stage,
+            "hang": exc.hang,
+            "detail": str(exc),
+        }
+    return 500, None, {"error": type(exc).__name__, "detail": str(exc)}
+
+
+class HttpFrontDoor:
+    """Request-handling policy shared by every endpoint: readiness,
+    admission, typed-outcome translation, per-status counters, and the
+    drain sequence. The HTTP handler class below is a thin I/O shim over
+    this object, so tests can drive the policy without sockets.
+
+    ``server``: a `ServeEngine` or `ServeFleet` (anything with
+    ``submit(key=, payload=, deadline_s=, variant=)`` and ``drain()``).
+    ``registry``: where the ``http_*`` counters live — pass the
+    server's own ``metrics`` registry to get one merged scrape.
+    """
+
+    def __init__(self, server, *, registry=None, key_fn=None,
+                 request_timeout_s=60.0, drain_timeout_s=None,
+                 clock=time.monotonic):
+        self._server = server
+        self._key_fn = key_fn if key_fn is not None else default_bucket_key
+        self._request_timeout = request_timeout_s
+        self._drain_timeout = drain_timeout_s
+        self._clock = clock
+        self._httpd = None
+        # engine submits must never block a handler thread on a full
+        # queue (timeout=0 -> typed AdmissionRejected); fleet submits
+        # have no timeout kwarg and never block by contract
+        params = inspect.signature(server.submit).parameters
+        self._submit_kwargs = {"timeout": 0} if "timeout" in params else {}
+        self.ready = threading.Event()
+        self._lock = concurrency.make_lock("serve.http")
+        self._accepting = True  # guarded by _lock
+        self._inflight = 0  # guarded by _lock
+        self._idle = threading.Event()
+
+        self.metrics = (
+            registry if registry is not None else MetricsRegistry()
+        )
+        self._m_requests = self.metrics.counter(
+            "http_requests_total", "HTTP requests received (all endpoints)"
+        )
+        self._m_by_code = {
+            code: self.metrics.counter(
+                f"http_responses_{code}_total",
+                f"HTTP responses with status {code}",
+            )
+            for code in _KNOWN_CODES
+        }
+        self._m_other = self.metrics.counter(
+            "http_responses_other_total",
+            "HTTP responses with any other status",
+        )
+
+    # -- accounting ----------------------------------------------------
+
+    def count_response(self, status):
+        self._m_by_code.get(status, self._m_other).inc()
+
+    def status_tally(self):
+        """``{status: count}`` over every response sent — the HTTP side
+        of the accounting reconciliation."""
+        tally = {
+            code: counter.value
+            for code, counter in self._m_by_code.items()
+            if counter.value
+        }
+        if self._m_other.value:
+            tally["other"] = self._m_other.value
+        return tally
+
+    # -- request path --------------------------------------------------
+
+    @property
+    def accepting(self):
+        with self._lock:
+            return self._accepting
+
+    def handle_match(self, body_bytes, headers):
+        """The POST /v1/match policy: parse, admit, submit, wait,
+        translate. Returns ``(status, extra_headers, body_dict)`` —
+        exactly one response per request, no exceptions escape."""
+        self._m_requests.inc()
+        with self._lock:
+            if not self._accepting:
+                return 503, {}, {
+                    "error": "draining",
+                    "detail": "server is draining; connection not accepted",
+                }
+            self._inflight += 1
+            self._idle.clear()
+        try:
+            with trace.span("http/match"):
+                return self._handle_match_inner(body_bytes, headers)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                if self._inflight == 0 and not self._accepting:
+                    self._idle.set()
+
+    def _handle_match_inner(self, body_bytes, headers):
+        try:
+            deadline_s, variant = self._parse_headers(headers)
+            body = json.loads(body_bytes.decode("utf-8"))
+            if not isinstance(body, dict):
+                raise ValueError("request body must be a JSON object")
+            payload = decode_payload(
+                body.get("payload"), body.get("dtypes")
+            )
+        except ValueError as exc:
+            return 400, {}, {"error": "bad_request", "detail": str(exc)}
+        key = self._key_fn(payload)
+        try:
+            fut = self._server.submit(
+                key=key, payload=payload, deadline_s=deadline_s,
+                variant=variant, **self._submit_kwargs,
+            )
+        except AdmissionRejected as exc:
+            return self._with_retry(*outcome_status(exc))
+        except ValueError as exc:  # unknown/unservable quality pin
+            return 400, {}, {"error": "bad_request", "detail": str(exc)}
+        except RuntimeError as exc:  # submit on a closed server
+            return 503, {}, {"error": "draining", "detail": str(exc)}
+        wait = (
+            self._request_timeout
+            if deadline_s is None
+            else deadline_s + 5.0
+        )
+        try:
+            result = fut.result(timeout=wait)
+        except FutureTimeoutError:
+            # the engine contract says every accepted future resolves;
+            # this is wedge insurance for the handler thread, not a path
+            # traffic should ever take
+            return 500, {}, {
+                "error": "wait_timeout",
+                "detail": f"no resolution within {wait:.1f}s",
+            }
+        except BaseException as exc:
+            return self._with_retry(*outcome_status(exc))
+        return 200, {}, {
+            "result": {
+                name: np.asarray(arr).tolist()
+                for name, arr in result.items()
+            }
+        }
+
+    def _parse_headers(self, headers):
+        deadline_s = None
+        raw = headers.get("X-Deadline-Ms")
+        if raw is not None:
+            try:
+                ms = float(raw)
+            except ValueError:
+                raise ValueError(
+                    f"X-Deadline-Ms must be a number, got {raw!r}"
+                ) from None
+            if ms <= 0:
+                raise ValueError(f"X-Deadline-Ms must be > 0, got {ms}")
+            deadline_s = ms / 1e3
+        variant = headers.get("X-Quality")
+        if variant is not None and variant not in VALID_QUALITY:
+            raise ValueError(
+                f"X-Quality must be one of {list(VALID_QUALITY)}, "
+                f"got {variant!r}"
+            )
+        return deadline_s, variant
+
+    @staticmethod
+    def _with_retry(status, retry_after_s, body):
+        extra = {}
+        if retry_after_s is not None:
+            # Retry-After is integer seconds on the wire; the precise
+            # hint rides in X-Retry-After-Ms for clients that can use it
+            extra["Retry-After"] = str(max(1, math.ceil(retry_after_s)))
+            extra["X-Retry-After-Ms"] = f"{retry_after_s * 1e3:.3f}"
+            body["retry_after_s"] = retry_after_s
+        return status, extra, body
+
+    def handle_healthz(self):
+        self._m_requests.inc()
+        if self.ready.is_set():
+            return 200, {}, {"status": "ok"}
+        return 503, {}, {"status": "unready"}
+
+    def handle_metrics(self):
+        self._m_requests.inc()
+        return 200, {}, self.metrics.to_prometheus()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def attach(self, httpd):
+        self._httpd = httpd
+
+    def mark_ready(self):
+        """Call after warmup: /healthz starts answering 200."""
+        self.ready.set()
+
+    def begin_drain(self, timeout=None, settle_s=2.0):
+        """The SIGTERM sequence, strictly ordered:
+
+        1. /healthz flips unready (load balancer stops routing) and new
+           /v1/match requests get 503 — the LISTENER stays open;
+        2. the engine/fleet drains: every in-flight request resolves
+           (result or typed shed) and its handler writes the response;
+        3. handler threads settle (bounded by ``settle_s``);
+        4. the listener closes (``httpd.shutdown``).
+
+        Idempotent; safe from a signal-watcher thread."""
+        self.ready.clear()
+        with self._lock:
+            self._accepting = False
+            idle = self._inflight == 0
+        if idle:
+            self._idle.set()  # nclint: disable=unguarded-shared-state -- Event is internally synchronized; set() outside _lock is safe because _accepting is already False, so no handler can clear() it again
+        self._server.drain(
+            timeout if timeout is not None else self._drain_timeout
+        )
+        self._idle.wait(settle_s)  # nclint: disable=unguarded-shared-state -- Event.wait MUST run outside _lock: the handler threads it waits for need the lock to record completion
+        if self._httpd is not None:
+            self._httpd.shutdown()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Socket I/O shim over the front door. HTTP/1.0 semantics: every
+    response closes its connection, so a drained server never strands a
+    keep-alive socket in a handler thread."""
+
+    front = None  # bound by make_http_server's subclass
+
+    def log_message(self, fmt, *args):
+        del fmt, args  # stdout/stderr belong to the CLI's reports
+
+    def _respond(self, status, extra_headers, body):
+        if isinstance(body, str):  # /metrics Prometheus text
+            data = body.encode("utf-8")
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        else:
+            data = json.dumps(body).encode("utf-8")
+            ctype = "application/json"
+        self.front.count_response(status)
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        for name, value in extra_headers.items():
+            self.send_header(name, value)
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._respond(*self.front.handle_healthz())
+        elif self.path == "/metrics":
+            self._respond(*self.front.handle_metrics())
+        else:
+            self._respond(404, {}, {"error": "not_found", "path": self.path})
+
+    def do_POST(self):
+        if self.path != "/v1/match":
+            self._respond(404, {}, {"error": "not_found", "path": self.path})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            length = 0
+        body = self.rfile.read(length) if length > 0 else b""
+        self._respond(*self.front.handle_match(body, self.headers))
+
+
+def make_http_server(front, host="127.0.0.1", port=0):
+    """Bind a `ThreadingHTTPServer` to the front door; ``port=0`` picks
+    an ephemeral port (read it back from ``httpd.server_address``). The
+    caller runs ``httpd.serve_forever()`` (or `start_http_server`)."""
+
+    class BoundHandler(_Handler):
+        pass
+
+    BoundHandler.front = front
+    httpd = ThreadingHTTPServer((host, port), BoundHandler)
+    httpd.daemon_threads = True
+    front.attach(httpd)
+    return httpd
+
+
+def start_http_server(server, *, host="127.0.0.1", port=0, registry=None,
+                      key_fn=None, request_timeout_s=60.0, ready=True):
+    """In-process convenience used by tests and the load drill: build a
+    front door + listener and run it on a daemon thread. Returns
+    ``(front, httpd, thread)``; stop with ``front.begin_drain()`` (or
+    ``httpd.shutdown()``) then ``httpd.server_close()`` and join."""
+    front = HttpFrontDoor(
+        server, registry=registry, key_fn=key_fn,
+        request_timeout_s=request_timeout_s,
+    )
+    httpd = make_http_server(front, host=host, port=port)
+    thread = threading.Thread(
+        target=httpd.serve_forever, name="http-serve", daemon=True
+    )
+    thread.start()
+    if ready:
+        front.mark_ready()
+    return front, httpd, thread

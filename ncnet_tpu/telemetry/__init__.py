@@ -29,7 +29,12 @@ CLI imports it without a device runtime.
 """
 
 from ncnet_tpu.telemetry import export, profiler, registry, session, trace
-from ncnet_tpu.telemetry.export import JsonlWriter, read_events, write_prometheus
+from ncnet_tpu.telemetry.export import (
+    JsonlWriter,
+    MetricStreamer,
+    read_events,
+    write_prometheus,
+)
 from ncnet_tpu.telemetry.profiler import ProfileWindow, parse_steps
 from ncnet_tpu.telemetry.registry import (
     DEFAULT_LATENCY_BUCKETS,
@@ -49,6 +54,7 @@ __all__ = [
     "Gauge",
     "Histogram",
     "JsonlWriter",
+    "MetricStreamer",
     "MetricsRegistry",
     "ProfileWindow",
     "TelemetrySession",

@@ -159,6 +159,54 @@ def metric_events(registry, ts=None):
     ]
 
 
+class MetricStreamer:
+    """Periodic incremental metric flush: the streaming/OTLP-shaped
+    bridge (ISSUE 17). A daemon thread calls ``flush_fn`` (normally
+    `TelemetrySession.flush_metrics`) every ``interval_s`` seconds, so
+    the events JSONL grows a metric record per registered metric while
+    the server is LIVE — a scraper can tail the file instead of waiting
+    for session stop. Each flush uses the writer's existing durable
+    complete-line discipline, and `scripts/telemetry_report.py` reads
+    the result unchanged: its final-metrics view keeps the LAST record
+    per name, so intermediate stream records simply become the coarse
+    time series.
+
+    A flush that raises (injected ``telemetry.write`` fault, transient
+    ENOSPC) leaves its events pending in the writer and the streamer
+    keeps ticking — the next interval retries them.
+    """
+
+    def __init__(self, flush_fn, interval_s, name="telemetry-stream"):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self._flush_fn = flush_fn
+        self._interval = interval_s
+        self._stop = threading.Event()
+        self.flushes = 0
+        self.errors = 0
+        self.thread = threading.Thread(
+            target=self._loop, name=name, daemon=True
+        )
+
+    def start(self):
+        self.thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self._flush_fn()
+                self.flushes += 1
+            except Exception:  # nclint: disable=swallowed-exception -- counted and retried next tick: the writer keeps un-flushed events pending, and a telemetry hiccup must never kill the stream (or the server it observes)
+                self.errors += 1
+
+    def stop(self, join_timeout=1.0):
+        """Idempotent; joins the thread bounded."""
+        self._stop.set()
+        if self.thread.is_alive():
+            self.thread.join(join_timeout)
+
+
 def write_prometheus(path, registry):
     """Durably write the registry's text exposition; returns bytes
     written."""

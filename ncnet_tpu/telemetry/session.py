@@ -24,6 +24,7 @@ from ncnet_tpu.telemetry import trace
 from ncnet_tpu.telemetry.export import (
     SCHEMA_VERSION,
     JsonlWriter,
+    MetricStreamer,
     events_name,
     metric_events,
     prom_name,
@@ -72,6 +73,7 @@ class TelemetrySession:
         })
         trace.enable(sink=self.writer.write)
         self._extra = []  # [(registry, tags)] — see add_registry
+        self._streamer = None
         self._stopped = False
 
     def add_registry(self, registry, tags=None):
@@ -94,10 +96,26 @@ class TelemetrySession:
                 self.writer.write(event)
         self.writer.flush()
 
+    def start_streaming(self, interval_s):
+        """Flush incremental metric records every ``interval_s`` seconds
+        (`export.MetricStreamer`): the live events JSONL becomes
+        tail-able mid-run — e.g. a scraper watching
+        `scripts/serve_http.py --telemetry-stream-s` — and
+        `scripts/telemetry_report.py` reads it unchanged (last record
+        per name wins). Returns the streamer; `stop` stops it."""
+        if self._streamer is not None:
+            raise RuntimeError("metric streaming already started")
+        self._streamer = MetricStreamer(
+            self.flush_metrics, interval_s
+        ).start()
+        return self._streamer
+
     def stop(self):
         if self._stopped:
             return
         self._stopped = True
+        if self._streamer is not None:
+            self._streamer.stop()
         trace.disable()
         self.flush_metrics()
         self.writer.close()

@@ -1,0 +1,311 @@
+"""HTTP serving CLI: the network front door over the serving stack.
+
+Boots a warmed `ServeEngine` (or `ServeFleet` with --fleet/--replicas)
+behind `ncnet_tpu.serve.http` and serves:
+
+  POST /v1/match   JSON {"payload": {name: nested lists}} with
+                   X-Deadline-Ms (budget propagated into admission
+                   control, deadline-aware micro-batch flush, and the
+                   per-bucket cost ladders) and X-Quality (pin a rung:
+                   refined / standard / degraded) headers
+  GET  /healthz    200 while serving; 503 before warmup and from the
+                   moment a drain begins (LB stops routing before
+                   SIGTERM completes)
+  GET  /metrics    Prometheus snapshot of the shared registry
+
+Typed outcomes map to wire status codes (`serve.http.outcome_status`):
+429 shed/admission-rejected (with Retry-After), 503 draining, 504
+deadline exceeded (failing stage in the body), 502 replica down, 500
+stage failure. SIGTERM runs the ordered drain: healthz flips unready ->
+in-flight requests finish -> listener closes -> the final JSON report
+prints -> exit 0 (drilled over a real subprocess in tests/test_http.py).
+
+Model sources:
+  --checkpoint CK          .msgpack checkpoint or reference .pth.tar
+  --synthetic              randomly-initialized TINY patch16 trunk — no
+                           checkpoint file needed; the chaos-drill /
+                           CI-smoke mode (shapes and contracts are real,
+                           weights are not)
+
+Warmup compiles every (bucket, batch-size, variant) program for the
+square --warm-sizes image buckets before the listener opens, so
+recompiles_after_warmup stays 0 across any traffic mix, rung flips, and
+X-Quality pins over those buckets.
+
+Example:
+  python scripts/serve_http.py --synthetic --image-size 32 --port 8080 \
+      --degrade 4 --per-bucket-quality --telemetry /tmp/t --telemetry-stream-s 2
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="ncnet_tpu HTTP serving front door")
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--checkpoint", type=str,
+                     help=".msgpack checkpoint or reference .pth.tar")
+    src.add_argument("--synthetic", action="store_true",
+                     help="serve a randomly-initialized TINY patch16 "
+                          "model (drill/smoke mode; no checkpoint)")
+    p.add_argument("--host", type=str, default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080,
+                   help="listen port (0 picks an ephemeral port; the "
+                        "bound address is printed on the 'serving:' line)")
+    p.add_argument("--image-size", type=int, default=400,
+                   help="bucket universe: max image side after resize")
+    p.add_argument("--warm-sizes", type=str, default=None,
+                   help="comma-separated square image sizes to warm as "
+                        "buckets (default: --image-size only)")
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-wait-ms", type=float, default=5.0)
+    p.add_argument("--queue-limit", type=int, default=64)
+    p.add_argument("--host-workers", type=int, default=2)
+    p.add_argument("--nc-topk", type=int, default=-1,
+                   help="override config.nc_topk (-1 keeps the model's)")
+    p.add_argument("--conv4d_impl", type=str, default="tlc")
+    p.add_argument("--degrade", type=int, default=-1,
+                   help="nc_topk of the pre-warmed DEGRADED program "
+                        "(-1 disables the cheap rung)")
+    p.add_argument("--refine", type=int, default=0, metavar="R",
+                   help="pool factor of the pre-warmed REFINED program "
+                        "(0 disables the rich rung)")
+    p.add_argument("--refine-topk", type=int, default=16, dest="refine_topk")
+    p.add_argument("--refine-radius", type=int, default=0,
+                   dest="refine_radius")
+    p.add_argument("--per-bucket-quality",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="cost-aware per-bucket QualityLadder (rung per "
+                        "bucket from ETA vs the tightest queued budget); "
+                        "--no-per-bucket-quality keeps one global "
+                        "controller")
+    p.add_argument("--deadline-flush",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="deadline-aware micro-batch flush; "
+                        "--no-deadline-flush is the fixed-wait baseline")
+    p.add_argument("--fleet", action="store_true",
+                   help="serve through a ServeFleet (one engine per "
+                        "device behind the best-ETA router)")
+    p.add_argument("--replicas", type=int, default=0,
+                   help="fleet size (implies --fleet; on CPU provisions "
+                        "an N-virtual-device proxy mesh)")
+    p.add_argument("--hang-timeout", type=float, default=30.0,
+                   help="dispatch heartbeat watchdog seconds (0 off)")
+    p.add_argument("--drain-timeout", type=float, default=10.0,
+                   help="graceful-drain deadline on SIGTERM")
+    p.add_argument("--request-timeout", type=float, default=60.0,
+                   help="handler-thread wait ceiling for requests "
+                        "without a deadline header")
+    p.add_argument("--telemetry", type=str, default=None, metavar="DIR",
+                   help="write a telemetry run under DIR (render with "
+                        "scripts/telemetry_report.py DIR)")
+    p.add_argument("--telemetry-stream-s", type=float, default=0.0,
+                   help="with --telemetry: flush incremental metric "
+                        "records every S seconds so a scraper can tail "
+                        "the live events JSONL (0 = only at stop)")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.replicas > 0:
+        args.fleet = True
+    if args.fleet and args.replicas > 1:
+        # CPU proxy mesh: must precede any jax import (XLA reads the
+        # flag once at client creation); no-op on real TPUs
+        flags = os.environ.get("XLA_FLAGS", "")
+        if ("jax" not in sys.modules
+                and "xla_force_host_platform_device_count" not in flags):
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{args.replicas}"
+            ).strip()
+
+    from ncnet_tpu import telemetry
+
+    if args.telemetry:
+        telemetry.start(args.telemetry, label="serve_http")
+        if args.telemetry_stream_s > 0:
+            telemetry.active().start_streaming(args.telemetry_stream_s)
+        print(f"telemetry: {args.telemetry} "
+              "(render with scripts/telemetry_report.py)", flush=True)
+    try:
+        return _run(args, telemetry)
+    finally:
+        telemetry.stop()
+
+
+def _load_model(args):
+    """(config, params) from a checkpoint or the synthetic TINY trunk."""
+    if args.synthetic:
+        import jax
+
+        from ncnet_tpu.models.immatchnet import (
+            ImMatchNetConfig,
+            init_immatchnet,
+        )
+
+        config = ImMatchNetConfig(
+            ncons_kernel_sizes=(3,), ncons_channels=(1,),
+            feature_extraction_cnn="patch16",
+        )
+        params = init_immatchnet(jax.random.PRNGKey(0), config)
+        return config, params
+    if args.checkpoint.endswith((".pth.tar", ".pth")):
+        from ncnet_tpu.utils.convert_torch import convert_checkpoint
+
+        return convert_checkpoint(args.checkpoint)
+    from ncnet_tpu.train.checkpoint import load_checkpoint
+
+    ck = load_checkpoint(args.checkpoint)
+    return ck.config, ck.params
+
+
+def _run(args, telemetry):
+    import numpy as np
+
+    from ncnet_tpu.resilience.signals import PreemptionGuard
+    from ncnet_tpu.serve import (
+        BucketSpec,
+        HttpFrontDoor,
+        ServeEngine,
+        ServeFleet,
+        default_bucket_key,
+        make_http_server,
+        make_serve_match_step,
+        payload_spec,
+    )
+
+    config, params = _load_model(args)
+    if args.conv4d_impl:
+        config = config.replace(conv4d_impl=args.conv4d_impl)
+    if args.nc_topk >= 0:
+        config = config.replace(nc_topk=args.nc_topk)
+    if getattr(config, "refine_factor", 0):
+        # refinement is a dispatch TIER here, not a baked-in config
+        config = config.replace(refine_factor=0)
+
+    apply_fn = make_serve_match_step(config)
+    degraded_apply_fn = None
+    refined_apply_fn = None
+    if args.degrade >= 0:
+        degraded_apply_fn = make_serve_match_step(
+            config.replace(nc_topk=args.degrade)
+        )
+    if args.refine > 0:
+        refined_apply_fn = make_serve_match_step(
+            config.replace(
+                refine_factor=args.refine,
+                refine_topk=args.refine_topk,
+                refine_radius=args.refine_radius,
+            )
+        )
+
+    hang = args.hang_timeout if args.hang_timeout > 0 else None
+    common = dict(
+        max_batch=args.max_batch,
+        max_wait=args.max_wait_ms / 1e3,
+        queue_limit=args.queue_limit,
+        host_workers=args.host_workers,
+        degraded_apply_fn=degraded_apply_fn,
+        refined_apply_fn=refined_apply_fn,
+        deadline_flush=args.deadline_flush,
+        per_bucket_quality=args.per_bucket_quality,
+    )
+    registry = telemetry.default_registry() if args.telemetry else None
+    if args.fleet:
+        server = ServeFleet(
+            apply_fn, params,
+            replicas=(args.replicas if args.replicas > 0 else None),
+            replica_hang_timeout=hang,
+            registry=registry,
+            **common,
+        )
+        if args.telemetry:
+            session = telemetry.active()
+            for rid, eng in server.engines().items():
+                session.add_registry(eng.metrics, tags={"replica": rid})
+    else:
+        server = ServeEngine(
+            apply_fn, params, registry=registry, hang_timeout=hang,
+            **common,
+        )
+
+    # warmup: square image buckets at each --warm-sizes side, keyed by
+    # the SAME default_bucket_key the front door computes per request
+    spec = BucketSpec(args.image_size, max(config.relocalization_k_size, 1))
+    sizes = (
+        [int(s) for s in args.warm_sizes.split(",")]
+        if args.warm_sizes else [args.image_size]
+    )
+    bucket_specs = []
+    for side in sizes:
+        h, w = spec.bucket(side, side)
+        payload = {
+            "source_image": np.zeros((h, w, 3), np.float32),
+            "target_image": np.zeros((h, w, 3), np.float32),
+        }
+        bucket_specs.append(
+            (default_bucket_key(payload), payload_spec(payload))
+        )
+    n_programs = server.warmup(bucket_specs)
+    print(f"warmup: {n_programs} programs over {len(bucket_specs)} "
+          "bucket(s)", flush=True)
+
+    front = HttpFrontDoor(
+        server,
+        registry=(registry if registry is not None
+                  else getattr(server, "metrics", None)),
+        request_timeout_s=args.request_timeout,
+        drain_timeout_s=args.drain_timeout,
+    )
+    httpd = make_http_server(front, host=args.host, port=args.port)
+    host, port = httpd.server_address[:2]
+    front.mark_ready()
+    print(f"serving: http://{host}:{port}", flush=True)
+
+    with PreemptionGuard() as guard:
+
+        def _watch():
+            # the HTTP-ordered drain: healthz unready -> engine drain ->
+            # listener close (serve_forever then returns below)
+            while True:
+                if guard.requested:
+                    front.begin_drain(timeout=args.drain_timeout)
+                    return
+                time.sleep(0.05)
+
+        watcher = threading.Thread(
+            target=_watch, name="http-preemption-drain", daemon=True
+        )
+        watcher.start()
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            # Ctrl-C without a SIGTERM: run the same ordered drain
+            os.kill(os.getpid(), signal.SIGTERM)
+        watcher.join(timeout=args.drain_timeout + 5.0)
+    httpd.server_close()
+
+    stats = server.report()
+    if args.fleet:
+        for rep_stats in stats.get("per_replica", {}).values():
+            rep_stats.pop("latencies_s", None)
+    else:
+        stats.pop("latencies_s", None)
+    stats["http_status_tally"] = front.status_tally()
+    text = json.dumps(stats, indent=2, sort_keys=True)
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,7 @@ import subprocess  # noqa: E402
 import sys  # noqa: E402
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 # The env var JAX_PLATFORMS is ignored when a TPU plugin is present in this
 # image; the config update reliably forces the CPU backend for tests.
@@ -96,3 +97,54 @@ def spawn_cpu_cluster(script, n_procs=2, local_devices=2, timeout=280,
             out = (out or "") + "\n[spawn_cpu_cluster] child timed out"
         results.append((p.returncode, out))
     return results
+
+
+@pytest.fixture(scope="session")
+def uninterrupted_run(tmp_path_factory):
+    """ONE uninterrupted run of the kill-drill training schedule, shared
+    session-wide (the tier-1 suite-budget lever, PR 17): before it,
+    tests/test_resilience.py and tests/test_distributed_ckpt.py each
+    paid this IDENTICAL 2-epoch compile+train in their own module-scoped
+    fixture. Schedule and seeds are pinned here; both modules' ``_run``
+    helpers must keep matching them (their bitwise comparisons fail
+    loudly on drift). Saves use the sharded (distributed_checkpoints)
+    format — the richer artifact: the distributed tests inspect the
+    save directories, while the resilience tests compare only loaded
+    VALUES, which test_sharded_training_matches_legacy_bitwise pins as
+    bitwise-equal across formats.
+
+    Returns ``(ck, metrics_lines, ckdir)``.
+    """
+    import json
+
+    from ncnet_tpu.data.loader import DataLoader
+    from ncnet_tpu.data.pairs import SyntheticPairDataset
+    from ncnet_tpu.models.immatchnet import (
+        ImMatchNetConfig,
+        init_immatchnet,
+    )
+    from ncnet_tpu.train.checkpoint import load_latest_valid_any
+    from ncnet_tpu.train.loop import train
+
+    cfg = ImMatchNetConfig(ncons_kernel_sizes=(3,), ncons_channels=(1,))
+    ds = SyntheticPairDataset(n=8, output_size=(32, 32), seed=11)
+    loader = DataLoader(
+        ds, 2, shuffle=True, seed=5, drop_last=True,
+        num_workers=1, prefetch=0,
+    )
+    params = init_immatchnet(jax.random.PRNGKey(0), cfg)
+    ckdir = tmp_path_factory.mktemp("uninterrupted_shared")
+    train(
+        cfg, params, loader, None,
+        num_epochs=2, checkpoint_dir=str(ckdir), data_parallel=False,
+        log_every=100, save_every_steps=2, keep_checkpoints=4,
+        distributed_checkpoints=True,
+    )
+    ck, _ = load_latest_valid_any(
+        os.path.join(str(ckdir), "ncnet_tpu.msgpack")
+    )
+    lines = [
+        json.loads(line)
+        for line in open(os.path.join(str(ckdir), "metrics.jsonl"))
+    ]
+    return ck, lines, ckdir

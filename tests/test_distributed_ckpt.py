@@ -431,11 +431,11 @@ def _assert_metrics_tails_match(lines_a, lines_b):
 
 
 @pytest.fixture(scope="module")
-def uninterrupted(tmp_path_factory):
-    ckdir = tmp_path_factory.mktemp("uninterrupted_sharded")
-    _run(ckdir)
-    ck, lines = _final_state(ckdir)
-    return ck, lines, ckdir
+def uninterrupted(uninterrupted_run):
+    """The session-shared uninterrupted run (tests/conftest.py): the
+    same sharded-save schedule `_run` executes, paid once for the whole
+    suite instead of once per module."""
+    return uninterrupted_run
 
 
 def _n_state_chunks(ckdir):

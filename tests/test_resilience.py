@@ -351,10 +351,13 @@ def _assert_metrics_tails_match(lines_a, lines_b):
 
 
 @pytest.fixture(scope="module")
-def uninterrupted(tmp_path_factory):
-    ckdir = tmp_path_factory.mktemp("uninterrupted")
-    _run(ckdir)
-    return _final_state(ckdir)
+def uninterrupted(uninterrupted_run):
+    """The session-shared uninterrupted run (tests/conftest.py) — the
+    same schedule `_run` executes, paid once for the whole suite. It is
+    saved in the sharded format, but only the loaded VALUES are compared
+    here, and test_distributed_ckpt.py pins sharded == legacy bitwise."""
+    ck, lines, _ = uninterrupted_run
+    return ck, lines
 
 
 def test_resume_after_crash_at_step_boundary(tmp_path, uninterrupted):
