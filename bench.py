@@ -33,6 +33,15 @@ the JSON carries nc_topk, band_occupancy, and the dense-equivalent
 analytic TFLOP/step so sparse and dense BENCH_r*.json trajectories stay
 comparable.
 
+``--corr-impl stream`` (band paths only) swaps the band's producer for
+the streamed tiled correlation (ops/corr_stream.py): bitwise the same
+band, identical FLOPs, peak memory O(hA*wA*(K+tile)) instead of the
+O(hA*wA*hB*wB) volume. The JSON records corr_impl and the traced
+liveness peaks of BOTH impls (corr_peak_bytes_dense /
+corr_peak_bytes_stream); benchmarks/micro_corr_stream.py sweeps the
+tile size. Step-time parity on CPU says nothing about the TPU win —
+the claim is bandwidth/HBM, re-measure on hardware (ROADMAP).
+
 Measured formulation ceiling (rounds 2-3, v5e). Round-3 calibrations: a
 plain [M, 400] @ [400, 400] GEMM sustains ~200 TFLOP/s on this chip and
 the tlc conv3d runs at 137 TFLOP/s hardware — the MXU is NOT the limit;
@@ -159,6 +168,41 @@ CONFIGS = {
 }
 
 
+def _corr_peak_bytes(batch, grid, feat_ch, k, mutual, tile):
+    """Traced liveness peaks (bytes) of BOTH correlation->band impls at
+    this run's band geometry — the memory half of the --corr-impl story,
+    measured the same way the audit's 0.35x gate is
+    (analysis.hlo_audit.jaxpr_memory_highwater over the jaxpr; trace
+    only, nothing compiles or runs). FLOPs are identical between the
+    impls (ops.accounting.corr_select_flops), so peak bytes is the
+    number that justifies flipping the switch."""
+    import numpy as np
+
+    from ncnet_tpu.analysis.hlo_audit import jaxpr_memory_highwater
+    from ncnet_tpu.ops.band import topk_band
+    from ncnet_tpu.ops.corr_stream import corr_stream_band
+    from ncnet_tpu.ops.correlation import correlation_4d
+    from ncnet_tpu.ops.matching import mutual_matching
+
+    import jax
+
+    feats = np.zeros((batch, grid, grid, feat_ch), np.float32)
+
+    def dense(fa, fb):
+        corr = correlation_4d(fa, fb)
+        return topk_band(
+            corr, k, values_from=mutual_matching(corr), mutual=mutual
+        )
+
+    def stream(fa, fb):
+        return corr_stream_band(fa, fb, k, mutual=mutual, tile=tile)
+
+    return (
+        jaxpr_memory_highwater(jax.make_jaxpr(dense)(feats, feats).jaxpr),
+        jaxpr_memory_highwater(jax.make_jaxpr(stream)(feats, feats).jaxpr),
+    )
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--config", default="pfpascal", choices=sorted(CONFIGS),
@@ -234,6 +278,22 @@ def main():
     p.add_argument("--refine-radius", type=int, default=0,
                    dest="refine_radius",
                    help="with --refine: extra window reach in coarse cells")
+    p.add_argument("--corr-impl", default="dense",
+                   choices=("dense", "stream"), dest="corr_impl",
+                   help="band paths only (--nc-topk or --refine): 'dense' "
+                        "materializes the full correlation volume before "
+                        "selecting; 'stream' (ops/corr_stream.py) tiles "
+                        "B's grid and folds each GEMM slab into a running "
+                        "top-K merge — the SAME band bitwise and the SAME "
+                        "FLOPs, at O(hA*wA*(K+tile)) peak memory instead "
+                        "of O(hA*wA*hB*wB). The JSON records corr_impl "
+                        "and the traced liveness peaks of both impls "
+                        "(corr_peak_bytes_dense / corr_peak_bytes_stream)")
+    p.add_argument("--corr-tile", type=int, default=128, dest="corr_tile",
+                   metavar="T",
+                   help="with --corr-impl stream: static B-grid slab "
+                        "width (clamped to hB*wB; 128 aligns with the "
+                        "TPU lane width)")
     p.add_argument("--bf16", action=argparse.BooleanOptionalAction,
                    default=True,
                    help="bf16 features/correlation/NC compute with f32 "
@@ -317,7 +377,15 @@ def _run(args):
         refine_factor=args.refine,
         refine_topk=args.refine_topk,
         refine_radius=args.refine_radius,
+        corr_impl=args.corr_impl,
+        corr_stream_tile=args.corr_tile,
     )
+    if args.corr_impl != "dense" and not (args.nc_topk or args.refine):
+        raise SystemExit(
+            f"--corr-impl {args.corr_impl} requires a band path "
+            "(--nc-topk K or --refine R): the dense NC stack consumes "
+            "the full correlation volume, so there is nothing to stream"
+        )
     if args.refine and (args.image_size // 16) % args.refine:
         raise SystemExit(
             f"--image_size {args.image_size} gives a "
@@ -452,11 +520,20 @@ def _run(args):
             grid=grid, image=size, from_features=from_features,
         )
         grid_lo = grid // args.refine
+        peak_d, peak_s = _corr_peak_bytes(
+            batch_size, grid_lo,
+            256 if config.feature_extraction_cnn == "patch16" else 1024,
+            min(args.refine_topk, grid_lo**2), args.nc_topk_mutual,
+            args.corr_tile,
+        )
         sparse_extras = {
             "refine_factor": args.refine,
             "refine_topk": min(args.refine_topk, grid_lo**2),
             "refine_window": refine_window(args.refine, args.refine_radius),
             "analytic_tflop_per_step_dense": round(dense_flops / 1e12, 2),
+            "corr_impl": args.corr_impl,
+            "corr_peak_bytes_dense": peak_d,
+            "corr_peak_bytes_stream": peak_s,
         }
     elif args.nc_topk:
         # the dense-vs-band analytic pair: BENCH_r*.json trajectories stay
@@ -467,10 +544,18 @@ def _run(args):
             grid=grid, image=size, from_features=from_features,
         )
         k_eff = min(args.nc_topk, grid**2)
+        peak_d, peak_s = _corr_peak_bytes(
+            batch_size, grid,
+            256 if config.feature_extraction_cnn == "patch16" else 1024,
+            k_eff, args.nc_topk_mutual, args.corr_tile,
+        )
         sparse_extras = {
             "nc_topk": k_eff,
             "band_occupancy": round(k_eff / grid**2, 4),
             "analytic_tflop_per_step_dense": round(dense_flops / 1e12, 2),
+            "corr_impl": args.corr_impl,
+            "corr_peak_bytes_dense": peak_d,
+            "corr_peak_bytes_stream": peak_s,
         }
     print(
         json.dumps(

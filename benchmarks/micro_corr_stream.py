@@ -1,0 +1,123 @@
+"""Microbench: the streamed tiled correlation->top-K band vs dense.
+
+What it measures, per tile size in the sweep:
+
+  * traced liveness peak (analysis.hlo_audit.jaxpr_memory_highwater) of
+    the streamed program vs the dense baseline — the number the tentpole
+    claims: O(hA*wA*(K+tile)) vs the O(hA*wA*hB*wB) volume;
+  * jitted step wall-time for both impls on this host;
+  * the exactness contract, hard-asserted before any timing: the
+    streamed band (values AND indices) is bitwise the dense
+    ``topk_band(correlation_4d(...), ...)`` reference.
+
+CPU-proxy discipline (PR 3/4): the EXACTNESS and PEAK-BYTES results
+transfer to TPU as-is — they are backend-independent program
+properties. The WALL-TIME comparison does not: on CPU both impls are
+compute-bound through the same GEMMs and the scan's sequential merge
+usually makes 'stream' slower; the streaming win is HBM footprint and
+bandwidth on TPU, where the dense volume's materialization is the cost.
+Re-measure on hardware before quoting a speedup (ROADMAP follow-up) —
+this file's honest claim is the memory column, not the ms column.
+
+Prints one JSON document.
+
+Usage:
+  python benchmarks/micro_corr_stream.py [--grid 25] [--feat-ch 256]
+      [--k 16] [--batch 4] [--tiles 32,64,128,256] [--steps 20]
+      [--no-mutual]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--grid", type=int, default=25,
+                   help="feature grid side (25 = the 400px config)")
+    p.add_argument("--feat-ch", type=int, default=256, dest="feat_ch")
+    p.add_argument("--k", type=int, default=16, help="band width")
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--tiles", default="32,64,128,256",
+                   help="comma-separated tile-size sweep")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--no-mutual", action="store_false", dest="mutual",
+                   default=True)
+    args = p.parse_args()
+
+    import jax
+
+    from ncnet_tpu.analysis.hlo_audit import jaxpr_memory_highwater
+    from ncnet_tpu.ops.band import topk_band
+    from ncnet_tpu.ops.corr_stream import corr_stream_band, resolve_corr_tile
+    from ncnet_tpu.ops.correlation import correlation_4d
+    from ncnet_tpu.ops.matching import mutual_matching
+
+    g, c, k, b = args.grid, args.feat_ch, args.k, args.batch
+    nb = g * g
+    rng = np.random.RandomState(0)
+    fa = jax.device_put(rng.randn(b, g, g, c).astype(np.float32))
+    fb = jax.device_put(rng.randn(b, g, g, c).astype(np.float32))
+
+    def dense(a, t):
+        corr = correlation_4d(a, t)
+        return topk_band(
+            corr, k, values_from=mutual_matching(corr), mutual=args.mutual
+        )
+
+    def timed(fn):
+        jfn = jax.jit(fn)
+        out = jax.block_until_ready(jfn(fa, fb))  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            out = jfn(fa, fb)
+        jax.block_until_ready(out)
+        return out, (time.perf_counter() - t0) / args.steps * 1e3
+
+    (want_v, want_i), dense_ms = timed(dense)
+    dense_peak = jaxpr_memory_highwater(jax.make_jaxpr(dense)(fa, fb).jaxpr)
+
+    sweep = []
+    for tile in (int(t) for t in args.tiles.split(",")):
+        def stream(a, t, tile=tile):
+            return corr_stream_band(a, t, k, mutual=args.mutual, tile=tile)
+
+        (got_v, got_i), ms = timed(stream)
+        # the contract, hard-asserted before the numbers mean anything:
+        # same band, bitwise (values compared as raw bits)
+        np.testing.assert_array_equal(np.asarray(got_i), np.asarray(want_i))
+        np.testing.assert_array_equal(
+            np.asarray(got_v).view(np.uint32),
+            np.asarray(want_v).view(np.uint32),
+        )
+        peak = jaxpr_memory_highwater(jax.make_jaxpr(stream)(fa, fb).jaxpr)
+        sweep.append({
+            "tile": resolve_corr_tile(tile, nb),
+            "step_ms": round(ms, 3),
+            "peak_bytes": peak,
+            "peak_vs_dense": round(peak / dense_peak, 4),
+        })
+
+    print(json.dumps({
+        "metric": "corr_stream_tile_sweep",
+        "backend": jax.default_backend(),
+        "grid": g, "feat_ch": c, "k": k, "batch": b,
+        "mutual": args.mutual,
+        "bitwise_equal": True,  # the asserts above would have raised
+        "corr_peak_bytes_dense": dense_peak,
+        "dense_step_ms": round(dense_ms, 3),
+        "sweep": sweep,
+        "note": "step_ms is a CPU proxy unless backend says tpu; the "
+                "transferable columns are peak_bytes and bitwise_equal",
+    }, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -57,9 +57,14 @@ from ncnet_tpu.analysis.jaxpr_audit import (
 # --- budgets (module-level so the golden tests can monkeypatch them) ---------
 
 #: entry-computation kernel launches per jaxpr contraction before
-#: fusion-fragmentation fires. Seed measurements (CPU, audit geometry):
-#: serve/eval 6.8-7.4, train/dense 10.2, train/dense-bf16 11.4,
-#: train/sparse 11.5, train/sparse-bf16 11.7 — budget is ~3x the worst.
+#: fusion-fragmentation fires. Calibration (CPU, audit geometry, PR-18
+#: program table): serve/eval 6.8-7.4, corr/stream 5.6, train/dense
+#: 10.2, train/sparse 11.5, train/refine 12.2, train/sparse-stream 13.1
+#: — and corr/dense 20.0, the new worst: a deliberately selection-heavy
+#: single-GEMM program (one correlation einsum + mutual ranking + top-K)
+#: whose launches/contraction is high BY DESIGN, not by fragmentation.
+#: The budget keeps the historical 36 rather than loosening; the
+#: effective headroom tightened from ~3x (old worst 11.7) to ~1.8x.
 FRAGMENTATION_OPS_PER_CONTRACTION = 36.0
 
 #: minimum entry-computation size for the fragmentation ratio to be
@@ -67,18 +72,28 @@ FRAGMENTATION_OPS_PER_CONTRACTION = 36.0
 FRAGMENTATION_MIN_OPS = 24
 
 #: un-fused transpose+copy ops tolerated in the entry computation before
-#: layout-churn fires: max(MIN_OPS, FRACTION * entry ops). Seed: dense
-#: programs 0-3 churn ops, the sparse band's scatter/gather lowering
-#: 23-25 of ~395 entry ops (6.4% — the fraction budget is ~2.3x that).
+#: layout-churn fires: max(MIN_OPS, FRACTION * entry ops). Calibration
+#: (PR-18 table): dense programs 0-3 churn ops, the sparse band's
+#: scatter/gather lowering 23-25 of ~395 entry ops (6.4%), and
+#: train/sparse-stream — whose scan-carried merge adds tile
+#: re-layouts — 35 of 473 (7.4%, the worst). The fraction budget stays
+#: 0.15, ~2x the worst measured; MIN_OPS only shields tiny programs.
 LAYOUT_CHURN_MIN_OPS = 24
 LAYOUT_CHURN_FRACTION = 0.15
 
 #: liveness-estimate budget: max(ABS floor, RATIO * program input bytes).
-#: Seed peak/input ratios: dense 1.02-1.08, train/dense-bf16 1.45,
-#: train/sparse 1.70 worst — RATIO is ~3.5x that; the floor only shields
-#: KB-scale toy programs from ratio noise.
+#: Calibration (PR-18 table) peak/input ratios: dense 1.02-1.08,
+#: train/dense-bf16 1.45, train/sparse and train/sparse-stream 1.70
+#: worst among ratio-governed programs — RATIO tightened 6.0 -> 4.0
+#: (~2.3x the worst) now that the streamed band proves selection can
+#: run without volume-sized transients. The floor shields small-input
+#: programs (localize/ransac 37x on 6 KiB of inputs; corr/stream 3.3x)
+#: — and corr/dense, the streaming memory BASELINE, sits at 3.5 MiB,
+#: deliberately just 1.14x under it: the dense volume is the cost the
+#: stream program exists to avoid, and if it grows past the floor the
+#: audit should say so rather than have the floor chase it.
 MEM_HIGHWATER_ABS_FLOOR = 4 * 1024 * 1024
-MEM_HIGHWATER_INPUT_RATIO = 6.0
+MEM_HIGHWATER_INPUT_RATIO = 4.0
 
 #: opcodes that are bookkeeping, not kernel launches
 _FREE_OPCODES = frozenset(

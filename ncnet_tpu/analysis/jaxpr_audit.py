@@ -583,7 +583,7 @@ def _feature_batch():
 
 
 def _build_train(nc_topk=0, from_features=False, half_precision=False,
-                 refine=False):
+                 refine=False, corr_stream=False):
     from ncnet_tpu.ops.accounting import train_step_flops_for_batch
     from ncnet_tpu.train.step import (
         create_train_state,
@@ -596,8 +596,15 @@ def _build_train(nc_topk=0, from_features=False, half_precision=False,
         # 2 -> 2x2 coarse, the full 4-wide coarse band, radius 0
         {"refine_factor": 2, "refine_topk": 4} if refine else {}
     )
+    stream_overrides = (
+        # the default tile (128) clamps to the 16-cell audit B grid, so
+        # the streamed GEMMs pad nothing and walk==form pins the streamed
+        # count EQUAL to train/sparse's — streaming buys memory, not FLOPs
+        {"corr_impl": "stream"} if corr_stream else {}
+    )
     config = _audit_config(
-        nc_topk=nc_topk, half_precision=half_precision, **refine_overrides
+        nc_topk=nc_topk, half_precision=half_precision,
+        **refine_overrides, **stream_overrides,
     )
     params = _audit_params(config)
     optimizer = make_optimizer()
@@ -618,6 +625,61 @@ def _build_train(nc_topk=0, from_features=False, half_precision=False,
         declared_dtype="bfloat16" if half_precision else None,
         donate_expect={0: "carried TrainState (params/opt_state/step)"},
         expected_flops=expected,
+    )
+
+
+#: dedicated correlation->band geometry (the ``corr/*`` programs): a
+#: 16x16 grid (256 cells/side) is large enough that the dense volume and
+#: its rank tensors dominate the dense program's highwater — measured
+#: 3.50 MiB dense vs 0.82 MiB stream (ratio 0.235), which is what the
+#: streaming ratio gate (tests/test_corr_stream.py: stream <= 0.35x
+#: dense) proves — while the dense program still clears the 4 MiB
+#: memory-highwater budget floor (hlo_audit.MEM_HIGHWATER_ABS_FLOOR).
+#: Tile 32 divides 256, so the streamed GEMM count is EXACTLY the dense
+#: count (walk==form with zero padding term)
+_CORR_GRID = 16
+_CORR_FEAT_CH = 64
+_CORR_TOPK = 12
+_CORR_TILE = 32
+
+
+def _build_corr(impl):
+    import jax
+
+    from ncnet_tpu.ops.accounting import corr_select_flops
+    from ncnet_tpu.ops.band import topk_band
+    from ncnet_tpu.ops.corr_stream import corr_stream_band
+    from ncnet_tpu.ops.correlation import correlation_4d
+    from ncnet_tpu.ops.matching import mutual_matching
+
+    k = _CORR_TOPK
+    if impl == "stream":
+
+        def select(fa, fb):
+            return corr_stream_band(
+                fa, fb, k, mutual=True, tile=_CORR_TILE
+            )
+
+    else:
+
+        def select(fa, fb):
+            corr = correlation_4d(fa, fb)
+            return topk_band(
+                corr, k, values_from=mutual_matching(corr), mutual=True
+            )
+
+    rng = np.random.default_rng(0)
+    shape = (_BATCH, _CORR_GRID, _CORR_GRID, _CORR_FEAT_CH)
+    fa = rng.standard_normal(shape).astype(np.float32)
+    fb = rng.standard_normal(shape).astype(np.float32)
+    n = _CORR_GRID * _CORR_GRID
+    return BuiltProgram(
+        fn=jax.jit(select),
+        args=(fa, fb),
+        expected_flops=corr_select_flops(
+            _BATCH, n, n, _CORR_FEAT_CH, corr_impl=impl,
+            corr_tile=_CORR_TILE,
+        ),
     )
 
 
@@ -784,6 +846,27 @@ PROGRAMS: Dict[str, ProgramSpec] = {
             "train/sparse",
             "sparse-band (nc_topk) training step from cached features",
             lambda: _build_train(nc_topk=4, from_features=True),
+        ),
+        ProgramSpec(
+            "train/sparse-stream",
+            "sparse-band training step with the streamed tiled "
+            "correlation (corr_impl='stream', ops/corr_stream.py)",
+            lambda: _build_train(
+                nc_topk=4, from_features=True, corr_stream=True
+            ),
+        ),
+        ProgramSpec(
+            "corr/dense",
+            "standalone dense correlation->mutual-band selection at the "
+            "16x16 corr geometry (the streaming memory baseline)",
+            lambda: _build_corr("dense"),
+        ),
+        ProgramSpec(
+            "corr/stream",
+            "streamed tiled correlation->mutual-band selection — same "
+            "band bitwise, highwater gated <= 0.35x corr/dense "
+            "(tests/test_corr_stream.py)",
+            lambda: _build_corr("stream"),
         ),
         ProgramSpec(
             "train/dense-bf16",

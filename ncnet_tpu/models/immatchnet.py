@@ -95,6 +95,20 @@ class ImMatchNetConfig:
     refine_factor: int = 0
     refine_topk: int = 16
     refine_radius: int = 0
+    # Correlation->band implementation: 'dense' (reference semantics —
+    # materialize the full [b, hA, wA, hB, wB] volume, then select) or
+    # 'stream' (ops/corr_stream.py: tile B's grid and fold each GEMM
+    # slab into a running top-K + row/col-maxima merge under lax.scan —
+    # BITWISE-equal band, peak memory O(hA*wA*(K+tile)) instead of
+    # O(hA*wA*hB*wB)). Only consulted on the band paths (nc_topk > 0 or
+    # refine_factor > 0); the dense-NC path consumes the full volume and
+    # rejects 'stream'. Legacy config dicts default to 'dense'.
+    corr_impl: str = "dense"
+    # Static B-grid slab width of the streaming GEMM (clamped to hB*wB).
+    # Larger tiles amortize the per-step merge over bigger MXU GEMMs;
+    # 128 aligns with the TPU lane width. Only read when
+    # corr_impl='stream'.
+    corr_stream_tile: int = 128
 
     def replace(self, **kw):
         return dataclasses.replace(self, **kw)
@@ -173,6 +187,13 @@ def match_pipeline(nc_params, config: ImMatchNetConfig, feat_a, feat_b):
             nc_params, config, feat_a, feat_b
         )
         return sparse_corr_to_dense(band, indices, grid_b)
+    if getattr(config, "corr_impl", "dense") != "dense":
+        raise ValueError(
+            f"corr_impl={config.corr_impl!r} requires a band path "
+            "(nc_topk > 0 or refine_factor > 0): the dense NC stack "
+            "consumes the full correlation volume, so there is nothing "
+            "to stream"
+        )
     delta4d = None
     if k > 1:
         corr, delta4d = correlation_maxpool4d(feat_a, feat_b, k)

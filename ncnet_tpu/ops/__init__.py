@@ -17,6 +17,7 @@ from ncnet_tpu.ops.band import (
     topk_band,
 )
 from ncnet_tpu.ops.conv4d import conv4d
+from ncnet_tpu.ops.corr_stream import corr_stream_band, resolve_corr_tile
 from ncnet_tpu.ops.coords import (
     normalize_axis,
     points_to_pixel_coords,
@@ -54,6 +55,8 @@ __all__ = [
     "band_to_dense",
     "topk_band",
     "conv4d",
+    "corr_stream_band",
+    "resolve_corr_tile",
     "correlation_3d",
     "correlation_4d",
     "correlation_maxpool4d",

@@ -60,9 +60,40 @@ def trunk_forward_flops(cnn, image):
     return resnet101_layer3_224 * (image / 224.0) ** 2
 
 
+def corr_select_flops(batch, n_a, n_b, feat_ch, corr_impl="dense",
+                      corr_tile=128):
+    """Contraction FLOPs (2*MACs) of one correlation->band selection pass
+    (the ``corr/dense`` and ``corr/stream`` audit programs).
+
+    Dense: the single all-pairs einsum, ``2 * n_a * n_b * c`` per sample
+    — the mutual-matching gate, ranking, top-K and gathers are
+    elementwise/comparison work the ledger counts as zero, matching the
+    jaxpr walk's convention.
+
+    Stream (ops/corr_stream.py): the SAME GEMMs, one B-tile at a time —
+    ``2 * n_a * ceil(n_b/tile)*tile * c`` per sample. When the tile
+    divides ``n_b`` this is EXACTLY the dense count; otherwise the
+    zero-padded tail columns of the last tile add
+    ``2 * n_a * (ceil(n_b/tile)*tile - n_b) * c``. Streaming is a
+    memory/bandwidth optimization, not a FLOP one: the win is peak
+    memory O(n_a*(K+tile)) vs O(n_a*n_b), never the arithmetic.
+    """
+    if corr_impl == "stream":
+        t = int(corr_tile)
+        if t <= 0:
+            raise ValueError(f"corr_tile={corr_tile} must be positive")
+        t = min(t, int(n_b))  # mirrors ops.corr_stream.resolve_corr_tile
+        n_tiles = -(-int(n_b) // t)
+        return float(batch) * 2.0 * n_a * (n_tiles * t) * feat_ch
+    if corr_impl != "dense":
+        raise ValueError(f"corr_impl={corr_impl!r} is not 'dense'|'stream'")
+    return float(batch) * 2.0 * n_a * n_b * feat_ch
+
+
 def train_step_flops(batch, kernels, channels, grid=25, feat_ch=1024,
                      image=400, from_features=False, nc_topk=0,
-                     cnn="resnet101", trunk_trainable=False):
+                     cnn="resnet101", trunk_trainable=False,
+                     corr_impl="dense", corr_tile=128):
     """Analytic FLOPs (2*MACs) per training step.
 
     Counted: 2 trunk forwards/sample (features reused for the rolled
@@ -89,9 +120,22 @@ def train_step_flops(batch, kernels, channels, grid=25, feat_ch=1024,
     reported against the reduced count. The top-K selection, pointer
     build, and gathers are integer/comparison work and are not counted
     (the correlation einsum, which the sparse path still runs, is).
+
+    With ``corr_impl='stream'`` (only legal on the band paths) the
+    correlation GEMMs run tiled (`corr_select_flops`): identical FLOPs
+    when ``corr_tile`` divides ``grid^2``, plus the padded-tail columns
+    otherwise. The streamed band's custom VJP never runs in a
+    frozen-trunk step — the features are constants under
+    ``d loss / d params``, so JAX AD prunes the whole selection from the
+    backward — which keeps the stream and dense counts' backward terms
+    identical.
     """
     trunk = 0.0 if from_features else 2 * trunk_forward_flops(cnn, image)
-    corr = 2 * 2.0 * grid**4 * feat_ch  # pos + neg
+    # pos + neg; the streamed variant only pads the last tile's columns
+    corr = 2 * corr_select_flops(
+        1, grid**2, grid**2, feat_ch, corr_impl=corr_impl,
+        corr_tile=corr_tile,
+    )
     n_b = grid**2 if not nc_topk else min(int(nc_topk), grid**2)
     nc_channels = [1, *channels]
     layer_flops = [
@@ -153,6 +197,8 @@ def train_step_flops_for_batch(config, batch, from_features=False,
             image=image,
             cnn=cnn,
             from_features=from_features,
+            corr_impl=getattr(config, "corr_impl", "dense"),
+            corr_tile=int(getattr(config, "corr_stream_tile", 128)),
         )
     return train_step_flops(
         b,
@@ -165,6 +211,8 @@ def train_step_flops_for_batch(config, batch, from_features=False,
         nc_topk=int(getattr(config, "nc_topk", 0)),
         cnn=cnn,
         trunk_trainable=trunk_trainable,
+        corr_impl=getattr(config, "corr_impl", "dense"),
+        corr_tile=int(getattr(config, "corr_stream_tile", 128)),
     )
 
 
@@ -178,11 +226,17 @@ def refine_window(factor, radius=0):
     return (int(factor) * (2 * int(radius) + 1)) ** 2
 
 
-def _coarse_band_flops(kernels, channels, grid_lo, nc_topk, feat_ch):
+def _coarse_band_flops(kernels, channels, grid_lo, nc_topk, feat_ch,
+                       corr_impl="dense", corr_tile=128):
     """One pair's coarse tier: correlation einsum + symmetric NC band
     forward at the pooled grid (the pooling itself is reduction work —
-    zero contraction FLOPs)."""
-    corr = 2.0 * grid_lo**4 * feat_ch
+    zero contraction FLOPs). ``corr_impl='stream'`` tiles the coarse
+    correlation (`corr_select_flops`); the tile clamps to the pooled
+    grid, so the default tile adds no padding at coarse sizes <= 128."""
+    corr = corr_select_flops(
+        1, grid_lo**2, grid_lo**2, feat_ch, corr_impl=corr_impl,
+        corr_tile=corr_tile,
+    )
     n_b = min(int(nc_topk), grid_lo**2)
     nc_channels = [1, *channels]
     nc_pass = sum(
@@ -203,7 +257,8 @@ def refine_rescore_flops(batch, grid_hi, nc_topk, window, feat_ch):
 
 def refine_match_flops(batch, kernels, channels, grid_hi, factor, nc_topk,
                        radius=0, feat_ch=256, image=0, cnn="patch16",
-                       from_features=False):
+                       from_features=False, corr_impl="dense",
+                       corr_tile=128):
     """Analytic FLOPs (2*MACs) of one refined match pass per batch
     (the ``refine/rescore`` serving program): 2 trunk forwards (unless
     fed from the feature store), the coarse correlation + symmetric NC
@@ -216,7 +271,8 @@ def refine_match_flops(batch, kernels, channels, grid_hi, factor, nc_topk,
     grid_lo = int(grid_hi) // int(factor)
     trunk = 0.0 if from_features else 2 * trunk_forward_flops(cnn, image)
     corr, nc_pass = _coarse_band_flops(
-        kernels, channels, grid_lo, nc_topk, feat_ch
+        kernels, channels, grid_lo, nc_topk, feat_ch,
+        corr_impl=corr_impl, corr_tile=corr_tile,
     )
     rescore = refine_rescore_flops(
         1, grid_hi, min(int(nc_topk), grid_lo**2),
@@ -227,7 +283,8 @@ def refine_match_flops(batch, kernels, channels, grid_hi, factor, nc_topk,
 
 def refine_train_step_flops(batch, kernels, channels, grid_hi, factor,
                             nc_topk, radius=0, feat_ch=256, image=0,
-                            cnn="patch16", from_features=False):
+                            cnn="patch16", from_features=False,
+                            corr_impl="dense", corr_tile=128):
     """Analytic FLOPs (2*MACs) per refined training step (the
     ``train/refine`` program): the coarse tier runs pos + neg like the
     band path — correlation x2, symmetric NC forward x2, band backward
@@ -244,7 +301,8 @@ def refine_train_step_flops(batch, kernels, channels, grid_hi, factor,
     grid_lo = int(grid_hi) // int(factor)
     trunk = 0.0 if from_features else 2 * trunk_forward_flops(cnn, image)
     corr, nc_pass = _coarse_band_flops(
-        kernels, channels, grid_lo, nc_topk, feat_ch
+        kernels, channels, grid_lo, nc_topk, feat_ch,
+        corr_impl=corr_impl, corr_tile=corr_tile,
     )
     nc_fwd = nc_pass * 2 * 2  # symmetric x (pos + neg)
     nc_bwd = 2 * nc_fwd

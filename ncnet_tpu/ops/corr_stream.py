@@ -1,0 +1,450 @@
+"""Streaming tiled correlation -> top-K band selection (corr_impl='stream').
+
+`sparse_match_pipeline` historically materialized the full dense
+``[b, hA, wA, hB, wB]`` correlation (``correlation_4d``) just to run
+``topk_band`` over it — making corr materialization the memory highwater
+of the sparse train, serve, and refine-coarse paths even though
+everything downstream of selection is O(K)-sparse. This module computes
+the SAME band without ever materializing the volume: B's grid is tiled,
+each ``[b, hA*wA, tile]`` correlation slab is one MXU GEMM, and the slab
+is folded into a running per-A-cell top-K merge under ``lax.scan``,
+together with the running row/col maxima the soft mutual-matching gate
+needs. Peak memory drops from O(nA*nB) to O(nA*(K + tile)) for the
+non-mutual band (plus an O(nB*K) column-candidate table and an
+O(nA*K^2) membership transient for ``mutual=True`` — still free of any
+nA*nB term).
+
+Exactness contract (pinned in tests/test_corr_stream.py): eagerly, the
+streamed band is BITWISE equal — values and indices, mutual on and off,
+rectangular grids, tiles that do not divide hB*wB — to the dense
+reference
+
+    corr  = correlation_4d(feat_a, feat_b)
+    gated = mutual_matching(corr, eps)
+    topk_band(corr, k, values_from=gated, mutual=mutual)
+
+This works because (a) a ``[b, nA, tile]`` einsum slab is bitwise equal
+to the corresponding slice of the full ``bijc,bklc->bijkl`` einsum (same
+contraction shape per output element; verified for f32 and bf16 on the
+CPU backend), (b) the top-K merge invariant top-K(top-K(S1) ∪ S2) =
+top-K(S1 ∪ S2) holds under the total order (value desc, index asc) that
+``lax.top_k`` resolves ties with, and (c) max is exact and associative,
+so the running row maxima equal the dense ``jnp.max`` reductions and the
+per-column maxima are complete within the single tile that owns the
+column. A ±0.0-signed row/col max cannot leak into the gate: the maxima
+are only ever consumed as ``max + eps``, which maps both zeros to the
+same sum.
+
+Mutual selection streams exactly via a candidate-superset theorem: the
+dense key is ``min(rank_a, rank_b) * nb + rank_a`` and every selected
+entry satisfies ``min(rank_a, rank_b) < K`` (any entry with
+``rank_a < K`` has key ``<= (K-1)*nb + nb-1 < K*nb``, which bounds every
+key with ``min >= K`` from below), so the selected set is contained in
+(row top-K by value) ∪ (column top-K by value). Row candidates carry
+their exact ``rank_a`` (their position in the merged row list);
+``rank_b`` is recovered by membership lookup in the owning column's
+top-Kc table (absence implies ``rank_b >= Kc >= min(K, nA)``, in which
+case ``min = rank_a`` already). Column candidates absent from the row
+list have ``rank_a >= K``, so their dense key ``(rank_b, rank_a)``
+ordering reduces to ``(rank_b, value desc, column asc)`` — no global
+rank needed. Their per-row grouping uses one static boundary scatter
+(the ``band_to_dense`` precedent: selection runs once, O(nB*K) sized —
+the dense reference itself materializes O(nA*nB) rank matrices here).
+A convenient corollary: ``mutual=True`` needs no int32 rank-key, so the
+streamed path lifts the dense ``nb <= 46340`` mutual limit (selection is
+identical wherever both are defined).
+
+The custom VJP is gather-only (the band backward discipline, see
+``sparse/nc.py``): cotangents route through the selected entries, the
+row-max entry ``(a, argmax_row a)`` and the col-max entry
+``(argmax_col j, j)``; ``d feat_b`` accumulates per B-tile under a
+second scan, so the backward never materializes nA*nB either and
+contains no scatter. Where the dense ``jnp.max`` VJP splits a tied
+maximum evenly, this routing picks the FIRST argmax — a measure-zero
+divergence on real features, and the forward (which is what the bitwise
+contract covers) is unaffected.
+
+Not supported: ``correlation_4d(normalization=True)`` (unused by
+ImMatchNet) and non-finite features (selection order under NaN is
+unspecified, exactly as for ``lax.top_k``).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def resolve_corr_tile(tile, nb):
+    """Clamp the static B-grid tile to ``[1, nb]``, rejecting nonsense."""
+    t = int(tile)
+    if t <= 0:
+        raise ValueError(
+            f"corr stream tile={t} must be positive (it is the static "
+            "B-grid slab width of the streaming GEMM)"
+        )
+    return min(t, int(nb))
+
+
+def _check_band_width(k, nb):
+    if not 1 <= k <= nb:
+        raise ValueError(
+            f"band width k={k} must be in [1, hB*wB={nb}] for the "
+            "streamed correlation band"
+        )
+
+
+def _tiles_of(fb_flat, tile):
+    """Pad the flattened B grid to a multiple of ``tile`` and split it
+    into scan-major ``[s, b, tile, c]`` slabs plus per-tile global column
+    ids and validity masks."""
+    b, nb, c = fb_flat.shape
+    s = -(-nb // tile)
+    pad = s * tile - nb
+    fbp = jnp.pad(fb_flat, ((0, 0), (0, pad), (0, 0)))
+    tiles = fbp.reshape(b, s, tile, c).transpose(1, 0, 2, 3)
+    cols = jnp.arange(s * tile, dtype=jnp.int32).reshape(s, tile)
+    valid = cols < nb
+    return tiles, cols, valid, s
+
+
+def _stack_cols(ys, nb):
+    """Un-tile a scan-stacked ``[s, b, tile, ...]`` output back to
+    column-major ``[b, nb, ...]`` (dropping the padded columns)."""
+    s, b, t = ys.shape[:3]
+    rest = ys.shape[3:]
+    out = ys.transpose(1, 0, 2, *range(3, ys.ndim))
+    return out.reshape(b, s * t, *rest)[:, :nb]
+
+
+def _stream_scan(fa_flat, fb_flat, k, mutual, tile):
+    """One pass over B's tiles.
+
+    Returns the raw-value row top-K ``(vals, idx)`` in row-rank order
+    (position == rank_a — the merge keeps the list sorted by
+    (value desc, index asc), the exact ``lax.top_k`` tie order), the
+    running row maxima/argmaxima, the per-column maxima/argmaxima, and
+    (mutual only) the per-column top-Kc value/row tables.
+    """
+    b, na, c = fa_flat.shape
+    nb = fb_flat.shape[1]
+    dt = fa_flat.dtype
+    neg_inf = jnp.array(-jnp.inf, dt)
+    kc = min(k, na)
+    idx_sentinel = jnp.int32(nb)
+
+    tiles, cols, valid, _ = _tiles_of(fb_flat, tile)
+
+    def step(carry, xs):
+        vals, idx, rm, argrm = carry
+        fb_tile, col, ok = xs
+        # the slab: bitwise equal to the dense einsum's column slice
+        slab = jnp.einsum(
+            "bnc,btc->bnt", fa_flat, fb_tile, preferred_element_type=dt
+        )
+        slab = jnp.where(ok[None, None, :], slab, neg_inf)
+        gidx = jnp.where(ok, col, idx_sentinel)
+        # fold the slab into the running row top-K: sort the K + tile
+        # candidates by (value desc, index asc) — lax.top_k's tie order —
+        # and keep the first K. top-K(top-K(S1) ∪ S2) == top-K(S1 ∪ S2).
+        cand_v = jnp.concatenate([vals, slab], axis=-1)
+        cand_i = jnp.concatenate(
+            [idx, jnp.broadcast_to(gidx[None, None, :], slab.shape)],
+            axis=-1,
+        )
+        neg_v, new_i = lax.sort((-cand_v, cand_i), dimension=-1, num_keys=2)
+        vals, idx = -neg_v[..., :k], new_i[..., :k]
+        # running row maximum; strict > keeps the FIRST argmax (VJP
+        # routing only — the forward never reads argrm)
+        tmax = jnp.max(slab, axis=-1)
+        targ = jnp.take(
+            gidx, jnp.argmax(slab, axis=-1), mode="clip"  # always in range
+        )
+        argrm = jnp.where(tmax > rm, targ, argrm)
+        rm = jnp.maximum(rm, tmax)
+        # column statistics are COMPLETE within the owning tile
+        cmax = jnp.max(slab, axis=1)
+        carg = jnp.argmax(slab, axis=1).astype(jnp.int32)
+        if mutual:
+            cv, ca = lax.top_k(jnp.swapaxes(slab, 1, 2), kc)
+            ys = (cmax, carg, cv, ca.astype(jnp.int32))
+        else:
+            ys = (cmax, carg)
+        return (vals, idx, rm, argrm), ys
+
+    init = (
+        jnp.full((b, na, k), neg_inf, dt),
+        jnp.full((b, na, k), idx_sentinel, jnp.int32),
+        jnp.full((b, na), neg_inf, dt),
+        jnp.zeros((b, na), jnp.int32),
+    )
+    (vals, idx, rm, argrm), ys = lax.scan(step, init, (tiles, cols, valid))
+    cm, argcm = _stack_cols(ys[0], nb), _stack_cols(ys[1], nb)
+    ctab = None
+    if mutual:
+        ctab = (_stack_cols(ys[2], nb), _stack_cols(ys[3], nb))
+    return vals, idx, rm, argrm, cm, argcm, ctab
+
+
+def _mutual_select(vals, idx, ctab_v, ctab_a, k):
+    """Exact ``mutual=True`` selection from the streamed candidates.
+
+    ``vals``/``idx`` are the row top-K in row-rank order (position ==
+    rank_a); ``ctab_v``/``ctab_a`` are the per-column top-Kc tables
+    (position == rank_b). Reproduces the dense key ``(min(ra, rb), ra)``
+    ordering on the candidate superset — see the module docstring for
+    why the superset is complete and why (value desc, column asc)
+    substitutes for rank_a among column-only candidates.
+    """
+    b, na, _ = vals.shape
+    nb, kc = ctab_v.shape[1], ctab_v.shape[2]
+    kk = jnp.int32(k)
+    trash = jnp.int32(na)
+
+    # rank_b of each row candidate: its position in the owning column's
+    # table (absence => rank_b >= Kc, where min(ra, rb) = ra already)
+    calist = jnp.take_along_axis(
+        ctab_a,
+        idx.reshape(b, na * k)[..., None],
+        axis=1,
+        mode="promise_in_bounds",
+    ).reshape(b, na, k, kc)
+    hit = calist == jnp.arange(na, dtype=jnp.int32)[None, :, None, None]
+    q = jnp.argmax(hit, axis=-1).astype(jnp.int32)
+    p = jnp.broadcast_to(
+        jnp.arange(k, dtype=jnp.int32)[None, None, :], (b, na, k)
+    )
+    rb_row = jnp.where(jnp.any(hit, axis=-1), q, kk)
+    k1_row = jnp.minimum(p, rb_row)
+
+    # column-only (class-3) candidates: flatten the tables to one entry
+    # list, drop entries already in their row's top-K list, group the
+    # survivors by row with a stable 4-key sort, and keep the best K per
+    # row (>= K better same-row column candidates rule an entry out)
+    e = nb * kc
+    a_e = ctab_a.reshape(b, e)
+    neg_e = -ctab_v.reshape(b, e)
+    j_e = jnp.broadcast_to(
+        jnp.arange(nb, dtype=jnp.int32)[None, :, None], (b, nb, kc)
+    ).reshape(b, e)
+    rb_e = jnp.broadcast_to(
+        jnp.arange(kc, dtype=jnp.int32)[None, None, :], (b, nb, kc)
+    ).reshape(b, e)
+    rlist = jnp.take_along_axis(
+        idx, a_e[..., None], axis=1, mode="promise_in_bounds"
+    )
+    in_row = jnp.any(rlist == j_e[..., None], axis=-1)
+    a_key = jnp.where(in_row, trash, a_e)
+    a_s, rb_s, neg_s, j_s = lax.sort(
+        (a_key, rb_e, neg_e, j_e), dimension=-1, num_keys=4
+    )
+    eids = jnp.arange(e, dtype=jnp.int32)[None, :]
+    first = jnp.concatenate(
+        [jnp.ones((b, 1), bool), a_s[:, 1:] != a_s[:, :-1]], axis=1
+    )
+    pos = eids - lax.cummax(jnp.where(first, eids, 0), axis=1)
+    keep = (pos < k) & (a_s < trash)
+    a_scat = jnp.where(keep, a_s, trash)
+    pos_scat = jnp.where(keep, pos, 0)
+    # the one static boundary scatter (band_to_dense precedent): row-
+    # grouped class-3 buffers, sentinel-initialized so empty slots sort
+    # after every real candidate (real primary keys are < K)
+    bi = jnp.arange(b, dtype=jnp.int32)[:, None]
+    shape3 = (b, na + 1, k)
+    c3_k1 = (
+        jnp.full(shape3, kk)
+        .at[bi, a_scat, pos_scat]
+        .set(rb_s, mode="promise_in_bounds")[:, :na]
+    )
+    c3_nv = (
+        jnp.zeros(shape3, vals.dtype)
+        .at[bi, a_scat, pos_scat]
+        .set(neg_s, mode="promise_in_bounds")[:, :na]
+    )
+    c3_j = (
+        jnp.full(shape3, jnp.int32(nb))
+        .at[bi, a_scat, pos_scat]
+        .set(j_s, mode="promise_in_bounds")[:, :na]
+    )
+
+    # final per-row merge of the 2K candidates under the dense order:
+    # (min-rank, rank_a-or-K, value desc, column asc). Row candidates
+    # have unique exact rank_a < K; class-3 ties resolve by the last two
+    # keys, the row-rank order restricted to rank_a >= K entries.
+    m_k1 = jnp.concatenate([k1_row, c3_k1], axis=-1)
+    m_k2 = jnp.concatenate(
+        [p, jnp.broadcast_to(kk, (b, na, k))], axis=-1
+    )
+    m_nv = jnp.concatenate([-vals, c3_nv], axis=-1)
+    m_j = jnp.concatenate([idx, c3_j], axis=-1)
+    _, _, s_nv, s_j = lax.sort(
+        (m_k1, m_k2, m_nv, m_j), dimension=-1, num_keys=4
+    )
+    return -s_nv[..., :k], s_j[..., :k]
+
+
+def _gate(vraw, rm, cm_sel, eps):
+    """The mutual-matching soft gate on band entries — the exact
+    elementwise form of ``ops.matching.mutual_matching`` restricted to
+    the selected cells: value * (value/(rowmax+eps)) * (value/(colmax+
+    eps)), grouped as the dense op groups it."""
+    ratio_a = vraw / (rm + eps)
+    ratio_b = vraw / (cm_sel + eps)
+    return vraw * (ratio_a * ratio_b)
+
+
+def _forward(feat_a, feat_b, k, mutual, tile, eps):
+    b, ha, wa, c = feat_a.shape
+    _, hb, wb, _ = feat_b.shape
+    na, nb = ha * wa, hb * wb
+    fa_flat = feat_a.reshape(b, na, c)
+    fb_flat = feat_b.reshape(b, nb, c)
+
+    vals, idx, rm, argrm, cm, argcm, ctab = _stream_scan(
+        fa_flat, fb_flat, k, mutual, tile
+    )
+    if mutual:
+        vals, idx = _mutual_select(vals, idx, ctab[0], ctab[1], k)
+    # canonical band order: indices ascending per A-cell (dense
+    # `jnp.sort(idx)`); selected columns are unique, so the 1-key stable
+    # sort is a deterministic permutation carrying the values along
+    idx, vraw = lax.sort((idx, vals), dimension=-1, num_keys=1)
+    cm_sel = jnp.take_along_axis(
+        cm, idx.reshape(b, na * k), axis=1, mode="promise_in_bounds"
+    ).reshape(b, na, k)
+    values = _gate(vraw, rm[..., None], cm_sel, eps)
+    shape = (b, ha, wa, k)
+    return (
+        (values.reshape(shape), idx.reshape(shape)),
+        (feat_a, feat_b, vraw, idx, rm, argrm, cm, argcm),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _stream_band(feat_a, feat_b, k, mutual, tile, eps):
+    out, _ = _forward(feat_a, feat_b, k, mutual, tile, eps)
+    return out
+
+
+def _stream_band_fwd(feat_a, feat_b, k, mutual, tile, eps):
+    return _forward(feat_a, feat_b, k, mutual, tile, eps)
+
+
+def _stream_band_bwd(k, mutual, tile, eps, res, ct):
+    """Gather-only backward (no scatter, no nA*nB tensor).
+
+    Each selected value is v = c^3 / ((rm+eps)(cm+eps)) with c the raw
+    correlation at the cell, rm/cm the row/column maxima — themselves
+    dot products at the (first-)argmax cells carried from the forward.
+    Cotangents route through exactly those three dot products; d feat_b
+    accumulates per B-tile under a scan so the transients stay
+    O(nA * K * tile).
+    """
+    feat_a, feat_b, vraw, idx, rm, argrm, cm, argcm = res
+    dval = ct[0]  # ct[1] is the float0 cotangent of the int32 indices
+    b, ha, wa, c = feat_a.shape
+    _, hb, wb, _ = feat_b.shape
+    na, nb = ha * wa, hb * wb
+    fa_flat = feat_a.reshape(b, na, c)
+    fb_flat = feat_b.reshape(b, nb, c)
+    dv = dval.reshape(b, na, k)
+
+    rmx = rm[..., None] + eps
+    cms = (
+        jnp.take_along_axis(
+            cm, idx.reshape(b, na * k), axis=1, mode="promise_in_bounds"
+        ).reshape(b, na, k)
+        + eps
+    )
+    val = vraw * ((vraw / rmx) * (vraw / cms))
+    g_c = dv * (3.0 * vraw * vraw) / (rmx * cms)
+    rm_terms = dv * (-val / rmx)
+    cm_terms = dv * (-val / cms)
+    d_rm = jnp.sum(rm_terms, axis=-1)
+
+    # d feat_a: selected entries and the row-max entry are plain gathers
+    fb_sel = jnp.take_along_axis(
+        fb_flat,
+        idx.reshape(b, na * k)[..., None],
+        axis=1,
+        mode="promise_in_bounds",
+    ).reshape(b, na, k, c)
+    dfa = jnp.einsum("bak,bakc->bac", g_c, fb_sel)
+    fb_rm = jnp.take_along_axis(
+        fb_flat, argrm[..., None], axis=1, mode="promise_in_bounds"
+    )
+    dfa = dfa + d_rm[..., None] * fb_rm
+
+    # d feat_b (and the col-max share of d feat_a), one B-tile at a time
+    tiles, cols, _, s = _tiles_of(fb_flat, tile)
+    pad = s * tile - nb
+    argcm_tiles = (
+        jnp.pad(argcm, ((0, 0), (0, pad)))
+        .reshape(b, s, tile)
+        .transpose(1, 0, 2)
+    )
+    a_ids = jnp.arange(na, dtype=jnp.int32)
+
+    def step(dfa_carry, xs):
+        fb_tile, col, acm = xs
+        onehot = (idx[..., None] == col[None, None, None, :]).astype(
+            g_c.dtype
+        )
+        w_fb = jnp.einsum("bak,bakt->bat", g_c, onehot)
+        dcm_t = jnp.einsum("bak,bakt->bt", cm_terms, onehot)
+        oh_rm = (argrm[..., None] == col[None, None, :]).astype(g_c.dtype)
+        w_fb = w_fb + oh_rm * d_rm[..., None]
+        dfb_tile = jnp.einsum("bat,bac->btc", w_fb, fa_flat)
+        # column-max routing: column j's max row gets dcm_j * fb[j] ...
+        oh_cm = (acm[:, None, :] == a_ids[None, :, None]).astype(g_c.dtype)
+        dfa_carry = dfa_carry + jnp.einsum(
+            "bat,btc->bac", oh_cm * dcm_t[:, None, :], fb_tile
+        )
+        # ... and fb[j] gets dcm_j * fa[argmax_col j] (a gather)
+        fa_cm = jnp.take_along_axis(
+            fa_flat, acm[..., None], axis=1, mode="promise_in_bounds"
+        )
+        dfb_tile = dfb_tile + dcm_t[..., None] * fa_cm
+        return dfa_carry, dfb_tile
+
+    dfa, dfb_tiles = lax.scan(step, dfa, (tiles, cols, argcm_tiles))
+    dfb = _stack_cols(dfb_tiles, nb)
+    return dfa.reshape(feat_a.shape), dfb.reshape(feat_b.shape)
+
+
+_stream_band.defvjp(_stream_band_fwd, _stream_band_bwd)
+
+
+def corr_stream_band(feat_a, feat_b, k, mutual=False, tile=128, eps=1e-5):
+    """Streamed correlation band: bitwise equal to
+
+        corr = correlation_4d(feat_a, feat_b)
+        topk_band(corr, k, values_from=mutual_matching(corr, eps),
+                  mutual=mutual)
+
+    without materializing ``corr``.
+
+    Args:
+      feat_a: ``[b, hA, wA, c]`` source features (channels-last).
+      feat_b: ``[b, hB, wB, c]`` target features.
+      k: static band width, ``1 <= k <= hB*wB``.
+      mutual: symmetric rank-union selection (see ``topk_band``). The
+        streamed path has no int32 rank-key, so it lifts the dense
+        ``hB*wB <= 46340`` mutual limit.
+      tile: static B-grid slab width of the streaming GEMM (clamped to
+        ``hB*wB``). Peak memory scales with ``hA*wA*(k + tile)``; larger
+        tiles amortize the merge over bigger MXU GEMMs.
+      eps: the mutual-matching gate epsilon (``mutual_matching``'s
+        default). Static.
+
+    Returns:
+      ``(values [b, hA, wA, K], indices int32 [b, hA, wA, K])`` with
+      indices sorted ascending per A-cell — the `topk_band` contract.
+    """
+    _, hb, wb, _ = feat_b.shape
+    nb = hb * wb
+    k = int(k)
+    _check_band_width(k, nb)
+    t = resolve_corr_tile(tile, nb)
+    return _stream_band(feat_a, feat_b, k, bool(mutual), t, float(eps))

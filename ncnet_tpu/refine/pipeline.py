@@ -22,13 +22,14 @@ pipeline, which is the exactness harness in tests/test_refine.py.
 
 from ncnet_tpu.refine.pool import pool_features
 from ncnet_tpu.refine.rescore import refine_rescore
-from ncnet_tpu.sparse.pipeline import sparse_match_pipeline
+from ncnet_tpu.sparse.pipeline import resolve_corr_impl, sparse_match_pipeline
 
 
 def check_refine_config(config):
     """Validate the refine settings before any tracing (the
     ``check_sparse_config`` discipline: a bad static config should fail
     at construction, not deep inside jit)."""
+    resolve_corr_impl(config)  # the coarse tier inherits corr_impl
     factor = int(getattr(config, "refine_factor", 0))
     if factor < 0:
         raise ValueError(
@@ -74,7 +75,9 @@ def refine_match_pipeline(nc_params, config, feat_a, feat_b):
     coarse = sparse_match_pipeline(
         nc_params,
         # the coarse tier IS the sparse band: same pipeline, band width
-        # taken from refine_topk (nc_topk stays the standard tier's knob)
+        # taken from refine_topk (nc_topk stays the standard tier's
+        # knob). corr_impl rides along unchanged, so a 'stream' config
+        # never materializes the coarse correlation volume either.
         config.replace(refine_factor=0, nc_topk=int(config.refine_topk)),
         fa_lo,
         fb_lo,

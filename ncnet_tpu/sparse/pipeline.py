@@ -14,16 +14,43 @@ PF-Pascal 400px config — cost O(K/(hB*wB)) of their dense count.
 
 With ``K = hB*wB`` the band is complete and every stage above reproduces
 its dense counterpart exactly (the test harness for all smaller K).
+
+``config.corr_impl`` selects how the band is produced: ``'dense'``
+(default, and what legacy config dicts get) materializes the full
+correlation volume first; ``'stream'`` computes the identical band —
+bitwise, values and indices — one B-grid tile at a time
+(ops/corr_stream.py), dropping the pipeline's peak memory from
+O(hA*wA*hB*wB) to O(hA*wA*(K+tile)).
 """
 
 import jax.numpy as jnp
 
 from ncnet_tpu.analysis import sanitizer
 from ncnet_tpu.ops.band import band_to_dense, topk_band
+from ncnet_tpu.ops.corr_stream import corr_stream_band
 from ncnet_tpu.ops.correlation import correlation_4d
 from ncnet_tpu.ops.matching import mutual_matching
 from ncnet_tpu.sparse.matching import band_mutual_matching
 from ncnet_tpu.sparse.nc import sparse_neigh_consensus_apply
+
+#: correlation->band implementations selectable via ``config.corr_impl``
+CORR_IMPLS = ("dense", "stream")
+
+
+def resolve_corr_impl(config):
+    """Validate and return the configured correlation implementation
+    (the ``check_sparse_config`` discipline: a bad static config fails
+    at construction, not deep inside jit). Legacy configs/dicts without
+    the field run the dense path unchanged."""
+    impl = getattr(config, "corr_impl", "dense")
+    if impl not in CORR_IMPLS:
+        raise ValueError(
+            f"corr_impl={impl!r} is not one of {CORR_IMPLS}: 'dense' "
+            "materializes the full correlation volume, 'stream' tiles "
+            "B's grid and selects the band with O(hA*wA*(K+tile)) peak "
+            "memory (ops/corr_stream.py)"
+        )
+    return impl
 
 
 def resolve_band_width(nc_topk, grid_b):
@@ -55,15 +82,28 @@ def sparse_match_pipeline(nc_params, config, feat_a, feat_b):
             "construct (set relocalization_k_size to 0)"
         )
     dtype = jnp.bfloat16 if config.half_precision else None
-    corr = correlation_4d(feat_a, feat_b)
-    corr = sanitizer.tap("correlation", corr)
-    gated = sanitizer.tap("mutual_matching_pre", mutual_matching(corr))
     grid_b = (feat_b.shape[1], feat_b.shape[2])
     k = resolve_band_width(config.nc_topk, grid_b)
-    values, indices = topk_band(
-        corr, k, values_from=gated,
-        mutual=getattr(config, "nc_topk_mutual", True),
-    )
+    mutual = getattr(config, "nc_topk_mutual", True)
+    if resolve_corr_impl(config) == "stream":
+        # streamed selection is BITWISE equal to the dense branch below
+        # (tests/test_corr_stream.py) but never materializes the volume;
+        # the sanitizer probes therefore see the selected band, not the
+        # full corr/gated tensors (same stage names, band support)
+        values, indices = corr_stream_band(
+            feat_a, feat_b, k, mutual=mutual,
+            tile=getattr(config, "corr_stream_tile", 128),
+        )
+        values = sanitizer.tap(
+            "mutual_matching_pre", sanitizer.tap("correlation", values)
+        )
+    else:
+        corr = correlation_4d(feat_a, feat_b)
+        corr = sanitizer.tap("correlation", corr)
+        gated = sanitizer.tap("mutual_matching_pre", mutual_matching(corr))
+        values, indices = topk_band(
+            corr, k, values_from=gated, mutual=mutual,
+        )
     if dtype:
         values = values.astype(dtype)
     band = sparse_neigh_consensus_apply(

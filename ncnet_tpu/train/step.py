@@ -143,6 +143,15 @@ def check_sparse_config(config):
             "not support relocalization (train with "
             "relocalization_k_size=0, as the reference does)"
         )
+    from ncnet_tpu.sparse.pipeline import resolve_corr_impl
+
+    impl = resolve_corr_impl(config)  # raises on unknown values
+    if impl != "dense" and not (nc_topk or getattr(config, "refine_factor", 0)):
+        raise ValueError(
+            f"corr_impl={impl!r} requires a band path (nc_topk > 0 or "
+            "refine_factor > 0): the dense NC stack consumes the full "
+            "correlation volume, so there is nothing to stream"
+        )
 
 
 def check_from_features_frozen(train_fe, fe_finetune_blocks):

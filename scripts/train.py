@@ -204,6 +204,22 @@ def main():
                         "(swap-closed up to capacity, better B-grid "
                         "coverage; the default) vs plain per-A top-K "
                         "(--no-nc_topk_mutual)")
+    p.add_argument("--corr-impl", choices=("dense", "stream"), default=None,
+                   dest="corr_impl",
+                   help="band-path correlation->top-K selection impl "
+                        "(ncnet_tpu.ops.corr_stream): 'stream' tiles "
+                        "B's grid and never materializes the "
+                        "[hA*wA, hB*wB] volume — bitwise-identical band, "
+                        "identical FLOPs, O(hA*wA*(K+tile)) peak memory "
+                        "(see README 'Streaming correlation'). Requires "
+                        "--nc_topk or --refine. Unset keeps a resumed "
+                        "checkpoint's recorded value (fresh configs: "
+                        "dense)")
+    p.add_argument("--corr-tile", type=int, default=None, dest="corr_tile",
+                   metavar="T",
+                   help="with --corr-impl stream: B-grid slab width of "
+                        "the streaming GEMM (default 128 = one TPU lane "
+                        "width; clamped to hB*wB)")
     p.add_argument("--refine", type=int, default=None, metavar="R",
                    help="coarse-to-fine refinement (ncnet_tpu.refine): "
                         "pool features by R, run the sparse band at the "
@@ -343,6 +359,9 @@ def main():
             nc_topk=args.nc_topk or 0,
             nc_topk_mutual=(True if args.nc_topk_mutual is None
                             else args.nc_topk_mutual),
+            corr_impl=args.corr_impl or "dense",
+            corr_stream_tile=(128 if args.corr_tile is None
+                              else args.corr_tile),
             refine_factor=args.refine or 0,
             refine_topk=(16 if args.refine_topk is None
                          else args.refine_topk),
@@ -382,6 +401,12 @@ def main():
             config = config.replace(nc_topk=args.nc_topk)
         if args.nc_topk_mutual is not None:
             config = config.replace(nc_topk_mutual=args.nc_topk_mutual)
+        if args.corr_impl is not None:  # selection impl: override in
+            # either direction; the band is bitwise-identical, so the
+            # resumed NC params are the same model under both impls
+            config = config.replace(corr_impl=args.corr_impl)
+        if args.corr_tile is not None:
+            config = config.replace(corr_stream_tile=args.corr_tile)
         if args.refine is not None:  # coarse-to-fine: override in either
             # direction; unset keeps the checkpoint's recorded value
             config = config.replace(refine_factor=args.refine)
@@ -451,6 +476,9 @@ def main():
             nc_topk=args.nc_topk or 0,
             nc_topk_mutual=(True if args.nc_topk_mutual is None
                             else args.nc_topk_mutual),
+            corr_impl=args.corr_impl or "dense",
+            corr_stream_tile=(128 if args.corr_tile is None
+                              else args.corr_tile),
             refine_factor=args.refine or 0,
             refine_topk=(16 if args.refine_topk is None
                          else args.refine_topk),

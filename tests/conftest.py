@@ -148,3 +148,100 @@ def uninterrupted_run(tmp_path_factory):
         for line in open(os.path.join(str(ckdir), "metrics.jsonl"))
     ]
     return ck, lines, ckdir
+
+
+@pytest.fixture(scope="session")
+def legacy_format_run(tmp_path_factory):
+    """ONE legacy-format (monolithic msgpack) run of the SAME pinned
+    schedule as `uninterrupted_run`, shared session-wide (the tier-1
+    budget lever, PR 18): the save-format parity drill
+    (tests/test_distributed_ckpt.py::
+    test_sharded_training_matches_legacy_bitwise) compares the two
+    fixtures instead of paying its own 2-epoch legacy training arm.
+    Schedule and seeds MUST stay identical to `uninterrupted_run` above
+    — the bitwise comparison fails loudly on drift, so the drill is not
+    weakened, only de-duplicated.
+
+    Returns ``(ck, metrics_lines, ckdir)`` with ``ck`` read through the
+    legacy single-file loader (the format under test).
+    """
+    import json
+
+    from ncnet_tpu.data.loader import DataLoader
+    from ncnet_tpu.data.pairs import SyntheticPairDataset
+    from ncnet_tpu.models.immatchnet import (
+        ImMatchNetConfig,
+        init_immatchnet,
+    )
+    from ncnet_tpu.train.checkpoint import load_checkpoint
+    from ncnet_tpu.train.loop import train
+
+    cfg = ImMatchNetConfig(ncons_kernel_sizes=(3,), ncons_channels=(1,))
+    ds = SyntheticPairDataset(n=8, output_size=(32, 32), seed=11)
+    loader = DataLoader(
+        ds, 2, shuffle=True, seed=5, drop_last=True,
+        num_workers=1, prefetch=0,
+    )
+    params = init_immatchnet(jax.random.PRNGKey(0), cfg)
+    ckdir = tmp_path_factory.mktemp("legacy_shared")
+    train(
+        cfg, params, loader, None,
+        num_epochs=2, checkpoint_dir=str(ckdir), data_parallel=False,
+        log_every=100, save_every_steps=2, keep_checkpoints=4,
+        distributed_checkpoints=False,
+    )
+    ck = load_checkpoint(os.path.join(str(ckdir), "ncnet_tpu.msgpack"))
+    lines = [
+        json.loads(line)
+        for line in open(os.path.join(str(ckdir), "metrics.jsonl"))
+    ]
+    return ck, lines, ckdir
+
+
+@pytest.fixture(scope="session")
+def multihost_oracle_loss():
+    """The single-process reference arm of the 2-process cluster drill
+    (tests/test_multihost.py), shared session-wide (the tier-1 budget
+    lever, PR 18): one data-parallel train step of the PINNED multihost
+    geometry — config ``(3, 3)/(4, 1)``, the seed-7 global batch of four
+    32x32 pairs, ``PRNGKey(0)`` init — on a 4-device mesh in THIS
+    process. The constants here must stay identical to the child script
+    in tests/test_multihost.py; the drill's allclose against the
+    cluster's psum-reduced loss fails loudly on drift.
+
+    Returns the oracle loss as a Python float.
+    """
+    import numpy as np
+
+    from ncnet_tpu.models.immatchnet import (
+        ImMatchNetConfig,
+        init_immatchnet,
+    )
+    from ncnet_tpu.parallel.mesh import make_mesh, replicate, shard_batch
+    from ncnet_tpu.train.step import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    grid_devices, image = 4, 32  # 2 processes x 2 local devices
+    config = ImMatchNetConfig(
+        ncons_kernel_sizes=(3, 3), ncons_channels=(4, 1)
+    )
+    rng = np.random.RandomState(7)
+    batch_np = {
+        "source_image": rng.randn(grid_devices, image, image, 3).astype(
+            np.float32
+        ),
+        "target_image": rng.randn(grid_devices, image, image, 3).astype(
+            np.float32
+        ),
+    }
+    mesh = make_mesh(devices=jax.devices()[:grid_devices])
+    params = init_immatchnet(jax.random.PRNGKey(0), config)
+    optimizer = make_optimizer()
+    state = create_train_state(replicate(mesh, params), optimizer)
+    state = state._replace(opt_state=replicate(mesh, state.opt_state))
+    batch = shard_batch(mesh, batch_np)
+    _, loss = make_train_step(config, optimizer, donate=False)(state, batch)
+    return float(loss)

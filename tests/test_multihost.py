@@ -120,7 +120,7 @@ def _child_main():
     )
 
 
-def test_two_process_cluster_matches_single_process():
+def test_two_process_cluster_matches_single_process(multihost_oracle_loss):
     results = spawn_cpu_cluster(
         os.path.abspath(__file__),
         n_procs=2,
@@ -141,29 +141,16 @@ def test_two_process_cluster_matches_single_process():
     # the loss is psum-reduced and replicated: both processes see the same
     assert losses[0] == losses[1], losses
 
-    # single-process oracle on a 4-device mesh over the same global batch
-    import jax
-
-    from ncnet_tpu.models.immatchnet import init_immatchnet
-    from ncnet_tpu.parallel.mesh import make_mesh, replicate, shard_batch
-    from ncnet_tpu.train.step import (
-        create_train_state,
-        make_optimizer,
-        make_train_step,
+    # single-process oracle on a 4-device mesh over the same global
+    # batch: the session-shared fixture (tests/conftest.py, the tier-1
+    # budget lever) — its pinned config/seeds mirror _config() /
+    # _global_batch() above, and this allclose fails loudly on drift.
+    # Random-init loss is ~1e-6 (score_neg - score_pos near zero), so the
+    # comparison needs an absolute floor: cross-process psum vs
+    # in-process reduction order differ by O(1 ulp) = ~3e-8 here
+    np.testing.assert_allclose(
+        losses[0], multihost_oracle_loss, rtol=1e-5, atol=1e-6
     )
-
-    config = _config()
-    mesh = make_mesh(devices=jax.devices()[:GRID_DEVICES])
-    params = init_immatchnet(jax.random.PRNGKey(0), config)
-    optimizer = make_optimizer()
-    state = create_train_state(replicate(mesh, params), optimizer)
-    state = state._replace(opt_state=replicate(mesh, state.opt_state))
-    batch = shard_batch(mesh, _global_batch())
-    _, want = make_train_step(config, optimizer, donate=False)(state, batch)
-    # random-init loss is ~1e-6 (score_neg - score_pos near zero), so the
-    # comparison needs an absolute floor: cross-process psum vs in-process
-    # reduction order differ by O(1 ulp) = ~3e-8 here
-    np.testing.assert_allclose(losses[0], float(want), rtol=1e-5, atol=1e-6)
 
 
 if __name__ == "__main__":
