@@ -103,6 +103,7 @@ reference lib/conv4d.py:39-48) on a 15.7 TFLOPs fp32 part => ~4 pairs/sec.
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -325,6 +326,21 @@ def main():
                         "bench/timed_chain spans and the headline "
                         "gauges, renderable with "
                         "scripts/telemetry_report.py DIR")
+    p.add_argument("--save-every-steps", type=int, default=0,
+                   dest="save_every_steps",
+                   help="checkpoint every N steps INSIDE the timed chain "
+                        "(legacy layout, throwaway temp dir): the "
+                        "sync-vs-async checkpoint A/B — per-save "
+                        "step-thread stall lands in the JSON as "
+                        "ckpt_stall_ms_p50/p95 and the chain wall time "
+                        "absorbs the saves. 0 = no checkpointing "
+                        "(the default throughput bench)")
+    p.add_argument("--async-checkpoints", action="store_true",
+                   dest="async_checkpoints",
+                   help="with --save-every-steps: overlap the saves via "
+                        "resilience.async_ckpt instead of blocking the "
+                        "chain for each one (coalescing counted in the "
+                        "JSON as ckpt_coalesced_total)")
     args = p.parse_args()
 
     from ncnet_tpu import telemetry
@@ -462,16 +478,84 @@ def _run(args):
             state, loss = step(state, batch)
             check_finite(float(loss), f"warmup step {w}")
 
+    # Optional checkpoint arm: durable legacy-layout saves inside the
+    # timed chain (throwaway dir), mirroring the training loop's
+    # mid-epoch cursor snapshots — sync blocks the chain per save, async
+    # hands off to the writer thread. The per-save STALL (what the step
+    # thread actually lost) is timed separately from the chain wall.
+    ackpt = None
+    ckpt_stalls = []
+    if args.save_every_steps:
+        import shutil
+        import tempfile
+
+        from ncnet_tpu.resilience.async_ckpt import (
+            AsyncCheckpointer,
+            device_snapshot,
+        )
+        from ncnet_tpu.train.checkpoint import (
+            CheckpointData,
+            materialize_on_host,
+            save_checkpoint,
+        )
+
+        ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+        ckpt_path = os.path.join(ckpt_dir, "bench.msgpack")
+        ackpt = AsyncCheckpointer(async_mode=args.async_checkpoints)
+
+        def submit_save(state, step_idx):
+            params_ref, opt_ref = state.params, state.opt_state
+            if args.async_checkpoints:
+                # the jitted step donates its carried state: overlapped
+                # saves snapshot through device-side copies (loop.py does
+                # the same) — dispatch only, no host sync
+                params_ref = device_snapshot(params_ref)
+                opt_ref = device_snapshot(opt_ref)
+            data = CheckpointData(
+                config=config, params=params_ref, opt_state=opt_ref,
+                step=step_idx,
+            )
+            ackpt.submit(
+                data,
+                lambda d: save_checkpoint(ckpt_path, d, keep=2),
+                prepare=materialize_on_host,
+                step=step_idx,
+                wait=not args.async_checkpoints,
+            )
+
     # Timed: steps chain through the state dependency, so ONE final D2H
     # forces the whole sequence; the ~80 ms roundtrip latency of this
     # platform is amortized over n_steps instead of paid per step.
     n_steps = args.steps
     with trace.span("bench/timed_chain"):
         t0 = time.perf_counter()
-        for _ in range(n_steps):
+        for s in range(n_steps):
             state, loss = step(state, batch)
+            if ackpt is not None and (s + 1) % args.save_every_steps == 0:
+                t_save = time.perf_counter()
+                submit_save(state, s + 1)
+                ckpt_stalls.append(time.perf_counter() - t_save)
+        if ackpt is not None:
+            # epoch-end barrier semantics: the chain wall honestly
+            # includes draining the writer, exactly like the loop
+            ackpt.flush()
         loss_host = float(loss)
         dt = time.perf_counter() - t0
+    ckpt_extras = {}
+    if ackpt is not None:
+        rep = ackpt.report()
+        ackpt.close()
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+        stall_ms = np.asarray(ckpt_stalls) * 1e3
+        ckpt_extras = {
+            "ckpt_mode": "async" if args.async_checkpoints else "sync",
+            "save_every_steps": args.save_every_steps,
+            "ckpt_saves_submitted": rep["submitted_total"],
+            "ckpt_saves_written": rep["written_total"],
+            "ckpt_coalesced_total": rep["coalesced_total"],
+            "ckpt_stall_ms_p50": round(float(np.percentile(stall_ms, 50)), 2),
+            "ckpt_stall_ms_p95": round(float(np.percentile(stall_ms, 95)), 2),
+        }
     check_finite(loss_host, f"timed chain ({n_steps} steps)")
     if args.sanitize:
         print(sanitizer.report_text(), flush=True)
@@ -575,6 +659,7 @@ def _run(args):
                 "mfu_vs_bf16_peak": round(mfu, 4),
                 "mfu_vs_f32_peak": round(mfu_f32, 4),
                 **sparse_extras,
+                **ckpt_extras,
                 **({"feature_cache": True} if from_features else {}),
                 **({"image_size": size} if size != 400 else {}),
                 **({"sanitized": True} if args.sanitize else {}),

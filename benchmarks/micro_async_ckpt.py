@@ -1,0 +1,179 @@
+"""Microbench: sync vs async checkpointing — what does the step loop pay?
+
+Simulates the training loop's mid-epoch cursor saves at the micro_ckpt
+geometry: a donating jitted update advances a synthetic state; every
+``--save-every-steps`` steps the state is durably checkpointed, either
+
+  sync   — the loop blocks for the full save (D2H funnel + serialization
+           + temp/fsync/rename), exactly the pre-async behaviour;
+  async  — `resilience.async_ckpt.AsyncCheckpointer` overlap: the loop
+           pays only the handoff (plus the donation-proof device-side
+           copy dispatch) and keeps stepping while the writer thread
+           saves; the epoch ends on a `flush()` barrier.
+
+Reported per (mode, layout, size):
+
+  ackpt_stall_ms_p50/p95  — per-save STEP-THREAD stall (the submit call:
+                            for sync that is the whole save wall; for
+                            async the handoff + snapshot dispatch)
+  ackpt_epoch_wall_ms     — end-to-end loop wall incl. the final flush
+  ackpt_coalesced         — overlapped saves superseded by a newer one
+
+plus a derived ``ackpt_stall_vs_sync_save`` ratio per (layout, size):
+async p50 stall / sync p50 save wall — the ISSUE-19 acceptance number
+(<= 0.2 on the sharded layout).
+
+The update is jitted with ``donate_argnums`` so the async arm exercises
+the real hazard: raw refs handed to the writer would be invalidated by
+the next step; `device_snapshot` copies are what make the overlap safe.
+
+Usage:
+  JAX_PLATFORMS=cpu python benchmarks/micro_async_ckpt.py \
+      [--steps 20] [--save-every-steps 5] [--leaf-kb 256] [--out DIR]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ncnet_tpu.models.immatchnet import ImMatchNetConfig
+from ncnet_tpu.resilience.async_ckpt import AsyncCheckpointer, device_snapshot
+from ncnet_tpu.train.checkpoint import (
+    CheckpointData,
+    materialize_on_host,
+    save_checkpoint,
+    save_checkpoint_sharded,
+    sharded_dir_for,
+)
+
+CFG = ImMatchNetConfig(ncons_kernel_sizes=(3,), ncons_channels=(1,))
+
+# same leaf-count geometry as micro_ckpt.py so rounds stay comparable
+SIZES = {"head": 32, "trunk": 320}
+
+
+def synthetic_state(n_leaves, leaf_kb, seed=0):
+    rng = np.random.RandomState(seed)
+    elems = max(1, (leaf_kb * 1024) // 4)
+    return {
+        f"layer{i:04d}": rng.randn(elems).astype(np.float32)
+        for i in range(n_leaves)
+    }
+
+
+def run_epoch(async_mode, layout, base, host_params, steps, save_every):
+    import jax
+    import jax.numpy as jnp
+
+    # donating update: the buffers behind a handed-off snapshot die when
+    # the NEXT step dispatches — the hazard device_snapshot exists for
+    update = jax.jit(
+        lambda t: jax.tree.map(lambda x: x + 1.0, t), donate_argnums=(0,)
+    )
+    state = jax.tree.map(jnp.asarray, host_params)
+    path = os.path.join(base, "ck.msgpack")
+    sdir = sharded_dir_for(path)
+    ackpt = AsyncCheckpointer(async_mode=async_mode)
+    stalls = []
+    t_epoch = time.perf_counter()
+    for s in range(steps):
+        state = update(state)
+        if (s + 1) % save_every == 0:
+            t0 = time.perf_counter()
+            params_ref = device_snapshot(state) if async_mode else state
+            data = CheckpointData(config=CFG, params=params_ref, step=s + 1)
+            if layout == "sharded":
+                ackpt.submit(
+                    data,
+                    lambda d: save_checkpoint_sharded(sdir, d, keep=1),
+                    step=s + 1,
+                    wait=not async_mode,
+                )
+            else:
+                ackpt.submit(
+                    data,
+                    lambda d: save_checkpoint(path, d, keep=1),
+                    prepare=materialize_on_host,
+                    step=s + 1,
+                    wait=not async_mode,
+                )
+            stalls.append(time.perf_counter() - t0)
+    ackpt.flush()
+    epoch_ms = (time.perf_counter() - t_epoch) * 1e3
+    rep = ackpt.report()
+    ackpt.close()
+    return np.asarray(stalls) * 1e3, epoch_ms, rep
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--save-every-steps", type=int, default=5,
+                   dest="save_every_steps")
+    p.add_argument("--leaf-kb", type=int, default=256)
+    p.add_argument("--out", default=None,
+                   help="work dir (default: a fresh temp dir, removed)")
+    args = p.parse_args()
+
+    work = args.out or tempfile.mkdtemp(prefix="micro_async_ckpt_")
+    try:
+        for size_name, n_leaves in SIZES.items():
+            host_params = synthetic_state(n_leaves, args.leaf_kb)
+            state_mb = sum(v.nbytes for v in host_params.values()) / 1e6
+            for layout in ("legacy", "sharded"):
+                sync_p50 = None
+                for mode in ("sync", "async"):
+                    base = os.path.join(work, f"{mode}_{layout}_{size_name}")
+                    os.makedirs(base, exist_ok=True)
+                    stalls, epoch_ms, rep = run_epoch(
+                        mode == "async", layout, base, host_params,
+                        args.steps, args.save_every_steps,
+                    )
+                    p50 = float(np.percentile(stalls, 50))
+                    p95 = float(np.percentile(stalls, 95))
+                    if mode == "sync":
+                        sync_p50 = p50
+                    tags = {
+                        "mode": mode, "layout": layout, "size": size_name,
+                        "state_mb": round(state_mb, 1),
+                        "saves": len(stalls),
+                    }
+                    for metric, value, unit in (
+                        ("ackpt_stall_ms_p50", round(p50, 2), "ms"),
+                        ("ackpt_stall_ms_p95", round(p95, 2), "ms"),
+                        ("ackpt_epoch_wall_ms", round(epoch_ms, 1), "ms"),
+                        ("ackpt_coalesced", rep["coalesced_total"], "saves"),
+                    ):
+                        print(
+                            json.dumps({
+                                "metric": metric, "value": value,
+                                "unit": unit, **tags,
+                            }),
+                            flush=True,
+                        )
+                    if mode == "async":
+                        print(
+                            json.dumps({
+                                "metric": "ackpt_stall_vs_sync_save",
+                                "value": round(p50 / max(sync_p50, 1e-9), 4),
+                                "unit": "ratio",
+                                "layout": layout, "size": size_name,
+                                "state_mb": round(state_mb, 1),
+                            }),
+                            flush=True,
+                        )
+    finally:
+        if args.out is None:
+            shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

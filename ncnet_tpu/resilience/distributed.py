@@ -48,6 +48,15 @@ each shard chunk), ``dckpt.manifest`` (meta + per-host manifest writes),
 ``dckpt.barrier`` (entering the cross-process barrier), ``dckpt.commit``
 (verification done, the commit rename still pending).
 
+Threading contract: with async checkpointing (`resilience.async_ckpt`)
+the whole save — chunk gathers, shard writes, and the commit barrier —
+runs on each process's dedicated writer thread; the barrier's
+file-polling wait tolerates that (no signal/main-thread dependency).
+What it does NOT tolerate is hosts disagreeing about WHICH saves happen:
+the training loop therefore disables coalescing for multi-process
+sharded runs (deterministic backpressure instead), so every process
+submits the same save sequence to its writer.
+
 Unlike its siblings this module imports jax/numpy (it must introspect
 shardings), so `resilience/__init__` does NOT import it eagerly — the
 loader workers' import-light contract holds; import it explicitly.

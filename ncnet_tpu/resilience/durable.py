@@ -25,6 +25,13 @@ rename leaves the previous artifact untouched; a kill between the data
 rename and the sidecar rename leaves a digest mismatch, so the new file
 is treated as invalid and recovery falls back one artifact — conservative
 by design.
+
+Threading contract: these functions are thread-agnostic — the discipline
+is identical whichever thread runs it, and with async checkpointing
+(`resilience.async_ckpt`) the whole sequence runs on the dedicated
+writer thread. At-most-one-writer PER PATH is the caller's job; the
+`AsyncCheckpointer` enforces it for checkpoints (one save in flight,
+ever), so concurrent temp files never collide.
 """
 
 import hashlib

@@ -52,6 +52,19 @@ the failures the recovery paths claim to survive:
                                 before each JSONL event-log flush, and mid-write
                                 of the ``.prom`` snapshot temp file — a crash
                                 must leave at most a torn trailing JSONL line
+  ``ackpt.handoff``             async checkpointing (`resilience.async_ckpt`):
+                                on the STEP thread, before the snapshot is
+                                enqueued to the writer — a kill here loses only
+                                the not-yet-handed-off save
+  ``ackpt.d2h``                 async writer thread, before the host gather /
+                                prepare stage (the save is torn: walk-back
+                                must skip it)
+  ``ackpt.write``               async writer thread, before the durable write
+                                (still torn; the durable layer's own points
+                                nest inside the write that follows)
+  ``ackpt.commit``              async writer thread, after the durable write
+                                returned — the save IS committed;
+                                ``latest_valid`` must land on it
   ============================  =================================================
 
 Actions: ``crash`` raises :class:`InjectedFault` (unwinds normally, finally

@@ -8,6 +8,18 @@ first signal into a flag the loop polls at step boundaries; a SECOND
 signal falls through to the previous handler (so a stuck run still dies
 on a double Ctrl-C).
 
+Second-signal flush hooks: with async checkpointing
+(resilience.async_ckpt) the final cursor save may still be in flight on
+the writer thread when the second signal lands. Falling through
+immediately would kill the process mid-write and ORPHAN that save (the
+walk-back contract keeps recovery correct, but the final cursor is
+lost). The loop registers a bounded flush hook (`add_flush_hook`); the
+second-signal path restores the previous handlers FIRST — a third
+signal during the grace still kills instantly — then drains the hooks
+best-effort, then re-delivers. Hooks must be bounded and reentrant-safe
+(they run inside a signal handler, possibly interrupting the very flush
+they call into).
+
 Only the main thread may install signal handlers; constructing the guard
 elsewhere (or where handlers are unavailable) degrades to a never-set
 flag rather than crashing — a loop guarded in a worker context simply
@@ -33,6 +45,7 @@ class PreemptionGuard:
         self._requested = threading.Event()
         self._previous = {}
         self._installed = False
+        self._flush_hooks = []
 
     @property
     def requested(self):
@@ -42,11 +55,33 @@ class PreemptionGuard:
         """Programmatic preemption (tests, in-process orchestrators)."""
         self._requested.set()
 
+    def add_flush_hook(self, hook):
+        """Register a bounded callable drained before a second signal is
+        re-delivered (e.g. ``lambda: ackpt.flush(timeout=5, reraise=False)``
+        — don't let the in-flight final save die half-written). Hooks run
+        inside a signal handler: keep them short, never let them raise
+        for control flow."""
+        self._flush_hooks.append(hook)
+
+    def remove_flush_hook(self, hook):
+        try:
+            self._flush_hooks.remove(hook)
+        except ValueError:
+            pass
+
     def _handle(self, signum, frame):
         if self._requested.is_set():
-            # second signal: restore + re-deliver so impatient operators
-            # (and process supervisors) keep their kill semantics
+            # second signal: restore FIRST (a third signal during the
+            # flush grace kills instantly — impatient operators and
+            # process supervisors keep their kill semantics), then give
+            # any in-flight durable write its bounded chance to commit,
+            # then re-deliver
             self._restore()
+            for hook in list(self._flush_hooks):
+                try:
+                    hook()
+                except Exception as e:  # a failed flush must not block death
+                    print(f"[resilience] flush hook failed: {e!r}", flush=True)
             signal.raise_signal(signum)
             return
         self._requested.set()

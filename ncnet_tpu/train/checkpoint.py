@@ -66,6 +66,21 @@ def _to_numpy(tree):
     return jax.tree.map(lambda x: np.asarray(x), tree)
 
 
+def materialize_on_host(data: CheckpointData) -> CheckpointData:
+    """Replace device params/opt_state trees with host copies — the
+    O(state) gather that is the legacy single-file layout's defining
+    constraint. The training loop hands this to the checkpoint writer
+    thread (resilience.async_ckpt) as the legacy ``prepare`` stage, so
+    the funnel runs OFF the step thread in sync and async mode alike;
+    sharded saves skip it (their per-host gathers happen chunkwise
+    inside `distributed.save_sharded`)."""
+    return dataclasses.replace(
+        data,
+        params=jax.device_get(data.params),  # nclint: disable=process-zero-only-io -- legacy layout needs the full tree on one host
+        opt_state=jax.device_get(data.opt_state),  # nclint: disable=process-zero-only-io -- legacy layout needs the full tree on one host
+    )
+
+
 def _relistify(obj):
     """Invert to_state_dict's list -> {'0': ..} conversion on restore."""
     if isinstance(obj, dict):

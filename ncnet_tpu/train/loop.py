@@ -34,11 +34,13 @@ from ncnet_tpu.ops.accounting import (
 )
 from ncnet_tpu.parallel.mesh import make_hybrid_mesh, replicate, shard_batch
 from ncnet_tpu.resilience import faultinject
+from ncnet_tpu.resilience.async_ckpt import AsyncCheckpointer, device_snapshot
 from ncnet_tpu.telemetry import trace
 from ncnet_tpu.telemetry.profiler import ProfileWindow
 from ncnet_tpu.telemetry.registry import default_registry
 from ncnet_tpu.train.checkpoint import (
     CheckpointData,
+    materialize_on_host,
     save_checkpoint,
     save_checkpoint_sharded,
     sharded_dir_for,
@@ -171,6 +173,7 @@ def train(
     preemption=None,
     from_features=False,
     distributed_checkpoints=False,
+    async_checkpoints=False,
 ):
     """Run the training loop; returns ``(state, history)``.
 
@@ -194,6 +197,18 @@ def train(
     ``<checkpoint_name stem>.dckpt/step_<N>/`` — the O(state) process-0
     ``device_get`` funnel of the legacy path disappears. Metrics and plots
     stay process-0-only (they are tiny and host-side either way).
+
+    ``async_checkpoints=True`` overlaps mid-epoch cursor saves with
+    training (`resilience.async_ckpt`): the step thread hands the writer
+    thread a donation-proof device snapshot (an O(leaves) copy DISPATCH,
+    no host sync) and keeps stepping while D2H + serialization + the
+    durable write happen off-thread; back-to-back snapshots coalesce to
+    the newest. Epoch-end/best and preemption-final saves still barrier
+    (``flush``), and the loop exit joins the writer — shutdown semantics
+    and the crash/walk-back contract are unchanged. In sync mode the
+    SAME writer thread is used with every save blocking, so the
+    ``device_get`` funnel is off the step thread either way and sync and
+    async runs produce byte-identical checkpoint files.
     """
     try:
         return _train_impl(
@@ -203,7 +218,7 @@ def train(
             start_batch, start_epoch_losses, opt_state, initial_best_val,
             initial_train_hist, initial_val_hist, log_every, profile_dir,
             profile_steps, save_every_steps, keep_checkpoints, preemption,
-            from_features, distributed_checkpoints,
+            from_features, distributed_checkpoints, async_checkpoints,
         )
     finally:
         _close_quietly(train_loader, val_loader)
@@ -216,6 +231,7 @@ def _train_impl(
     opt_state, initial_best_val, initial_train_hist, initial_val_hist,
     log_every, profile_dir, profile_steps, save_every_steps,
     keep_checkpoints, preemption, from_features, distributed_checkpoints,
+    async_checkpoints,
 ):
     if from_features:
         from ncnet_tpu.train.step import check_from_features_frozen
@@ -266,11 +282,31 @@ def _train_impl(
         os.makedirs(checkpoint_dir, exist_ok=True)
         open(metrics_path, "w").close()
 
-    def snapshot(epoch, losses, is_best=False, cursor_batch=None):
+    # One checkpoint writer per run, in SYNC mode too: every save's D2H
+    # funnel + serialization + fsync runs on the writer thread (submits
+    # just block for it), so the step thread never executes the gather
+    # itself. Multi-process sharded saves are collective — a snapshot
+    # coalesced on one host but written on another would wedge the
+    # commit barrier — so coalescing degrades to deterministic
+    # backpressure there (every process writes the same save sequence).
+    ackpt = AsyncCheckpointer(
+        async_mode=async_checkpoints,
+        coalesce=not (distributed_checkpoints and jax.process_count() > 1),
+    )
+    # a second SIGTERM during an in-flight final save gets a bounded
+    # grace to commit before the guard re-delivers (signals.py)
+    preempt_flush = lambda: ackpt.flush(timeout=5.0, reraise=False)
+    if preemption is not None and hasattr(preemption, "add_flush_hook"):
+        preemption.add_flush_hook(preempt_flush)
+
+    def snapshot(epoch, losses, is_best=False, cursor_batch=None, wait=True):
         """One durable checkpoint; ``cursor_batch`` marks a mid-epoch
         snapshot carrying the loader cursor for step-granular resume.
         Sharded mode is COLLECTIVE — every process enters and writes its
-        own shards; legacy mode stays process-0-only."""
+        own shards; legacy mode stays process-0-only. ``wait=False``
+        (async mode only) overlaps the save with training: the handoff
+        snapshots the immutable tree refs and returns; D2H and the
+        durable write happen on the writer thread."""
         if not distributed_checkpoints and jax.process_index() != 0:
             return  # legacy multi-host: only process 0 writes checkpoints
         cursor = None
@@ -287,8 +323,18 @@ def _train_impl(
                 "epoch_losses": list(losses.host()),
             }
         os.makedirs(checkpoint_dir, exist_ok=True)
-        common = dict(
+        overlap = async_checkpoints and not wait
+        params_ref, opt_ref = state.params, state.opt_state
+        if overlap:
+            # the jitted step donates its carried state, so an overlapped
+            # writer can't hold the live buffers across the next dispatch;
+            # snapshot through device-side copies (dispatch only, no sync)
+            params_ref = device_snapshot(params_ref)
+            opt_ref = device_snapshot(opt_ref)
+        data = CheckpointData(
             config=config,
+            params=params_ref,
+            opt_state=opt_ref,
             step=int(state.step),
             epoch=epoch if cursor_batch is not None else epoch + 1,
             train_loss=np.asarray(train_hist),
@@ -301,28 +347,27 @@ def _train_impl(
         if distributed_checkpoints:
             # params/opt_state stay on device: each process serializes
             # only the shard chunks it owns — nothing O(state) funnels
-            # through any single host
-            save_checkpoint_sharded(
-                sharded_dir_for(os.path.join(checkpoint_dir, checkpoint_name)),
-                CheckpointData(
-                    params=state.params, opt_state=state.opt_state, **common
-                ),
-                is_best=is_best,
-                keep=keep_checkpoints,
-            )
-            return
-        save_checkpoint(
-            os.path.join(checkpoint_dir, checkpoint_name),
-            CheckpointData(
-                # the O(state) process-0 gather is the legacy single-file
-                # format's defining constraint, kept deliberately for
-                # single-host runs; --distributed-checkpoints removes it
-                params=jax.device_get(state.params),  # nclint: disable=process-zero-only-io -- legacy layout needs the full tree on one host
-                opt_state=jax.device_get(state.opt_state),  # nclint: disable=process-zero-only-io -- legacy layout needs the full tree on one host
-                **common,
-            ),
-            is_best=is_best,
-            keep=keep_checkpoints,
+            # through any single host (the chunk gathers run inside
+            # save_sharded, on the writer thread)
+            sdir = sharded_dir_for(os.path.join(checkpoint_dir, checkpoint_name))
+
+            def write(d):
+                save_checkpoint_sharded(
+                    sdir, d, is_best=is_best, keep=keep_checkpoints
+                )
+
+            prepare = None
+        else:
+            path = os.path.join(checkpoint_dir, checkpoint_name)
+
+            def write(d):
+                save_checkpoint(path, d, is_best=is_best, keep=keep_checkpoints)
+
+            # the O(state) gather the legacy single-file format demands,
+            # as the writer-thread prepare stage (checkpoint.py)
+            prepare = materialize_on_host
+        ackpt.submit(
+            data, write, prepare=prepare, step=int(data.step), wait=wait
         )
 
     # Telemetry (ncnet_tpu.telemetry): per-step spans split host data-wait
@@ -352,155 +397,166 @@ def _train_impl(
     window = ProfileWindow(profile_dir, profile_steps)
     preempted = False
     done = object()  # prefetch-exhausted sentinel
-    for epoch in range(start_epoch, num_epochs):
-        t0 = time.perf_counter()
-        t_last = t0
-        t_step = t0
-        skip = start_batch if epoch == start_epoch else 0
-        # a resumed epoch re-seeds its already-computed step losses so the
-        # epoch mean is over ALL its steps, not just the replayed tail
-        losses = _LossLog(start_epoch_losses if skip else None)
-        batches = _epoch_iter(train_loader, epoch, skip=skip)
-        prefetch = _prefetch_device_batches(mesh, batches)
+    clean_exit = False
+    try:
+        for epoch in range(start_epoch, num_epochs):
+            t0 = time.perf_counter()
+            t_last = t0
+            t_step = t0
+            skip = start_batch if epoch == start_epoch else 0
+            # a resumed epoch re-seeds its already-computed step losses so the
+            # epoch mean is over ALL its steps, not just the replayed tail
+            losses = _LossLog(start_epoch_losses if skip else None)
+            batches = _epoch_iter(train_loader, epoch, skip=skip)
+            prefetch = _prefetch_device_batches(mesh, batches)
 
-        def sync_losses():
-            # D2H sync so the device finishes the profiled steps before a
-            # trace closes (block_until_ready does not block on the
-            # tunneled platform — see bench.py)
-            if len(losses):
-                losses.host()
+            def sync_losses():
+                # D2H sync so the device finishes the profiled steps before a
+                # trace closes (block_until_ready does not block on the
+                # tunneled platform — see bench.py)
+                if len(losses):
+                    losses.host()
 
-        i = skip - 1
-        while True:
-            # the data-wait span is the host blocked on the loader +
-            # H2D prefetch — when it dominates, the input pipeline is
-            # the bottleneck, not the device
-            with trace.span("step/data_wait"):
-                dbatch = next(prefetch, done)
-            if dbatch is done:
+            i = skip - 1
+            while True:
+                # the data-wait span is the host blocked on the loader +
+                # H2D prefetch — when it dominates, the input pipeline is
+                # the bottleneck, not the device
+                with trace.span("step/data_wait"):
+                    dbatch = next(prefetch, done)
+                if dbatch is done:
+                    break
+                i += 1
+                if profile_dir and epoch == start_epoch:
+                    window.on_step(i, sync=sync_losses)
+                with trace.span("step/device_compute"):
+                    # asynchronous dispatch: host-side cost of launching the
+                    # step; device execution time lands in the NEXT sync
+                    # (step/loss_sync or the epoch-end mean)
+                    state, loss = train_step(state, dbatch)
+                losses.append(loss)
+                m_steps.inc()
+                now_step = time.perf_counter()
+                m_step_s.observe(now_step - t_step)
+                t_step = now_step
+                faultinject.fire("step.boundary")
+                if sanitizer.is_enabled():
+                    # sanitized runs are diagnostic: pay a per-step D2H sync so
+                    # a non-finite loss stops IMMEDIATELY with the per-stage
+                    # report + first non-finite stage, instead of averaging
+                    # NaN into the epoch
+                    with trace.span("step/loss_sync"):
+                        loss_last = losses.host()[-1]
+                    sanitizer.check_finite_or_report(
+                        loss_last,
+                        context=f"epoch {epoch + 1} step {i + 1}",
+                    )
+                if (i + 1) % log_every == 0:
+                    # host() syncs on the just-appended loss, keeping the step
+                    # timing honest without a second transfer of that loss
+                    with trace.span("step/loss_sync"):
+                        loss_host = losses.host()[-1]
+                    now = time.perf_counter()
+                    ms = (now - t_last) / log_every * 1e3
+                    t_last = now
+                    m_step_ms.set(ms)
+                    achieved = train_step_flops_for_batch(
+                        config, dbatch, from_features=from_features,
+                        trunk_trainable=train_fe or fe_finetune_blocks > 0,
+                    ) / (max(ms, 1e-6) / 1e3)
+                    m_mfu.set(achieved / V5E_BF16_PEAK_FLOPS)
+                    m_mfu_f32.set(achieved / peak_flops("float32"))
+                    print(
+                        f"epoch {epoch + 1} [{i + 1}/{len(train_loader)}] "
+                        f"loss {loss_host:.6f} ({ms:.0f} ms/step)",
+                        flush=True,
+                    )
+                want_preempt = preemption is not None and preemption.requested
+                if (
+                    save_every_steps and (i + 1) % save_every_steps == 0
+                ) or want_preempt:
+                    # mid-epoch durable snapshot with the loader cursor; the
+                    # float() syncs are confined to snapshot boundaries
+                    snapshot(epoch, losses, cursor_batch=i + 1, wait=want_preempt)
+                if want_preempt:
+                    print(
+                        f"preempted at epoch {epoch + 1} step {i + 1}: "
+                        "checkpoint written, exiting cleanly",
+                        flush=True,
+                    )
+                    preempted = True
+                    break
+            window.close(sync=sync_losses)  # epoch shorter than the window
+            if preempted:
                 break
-            i += 1
-            if profile_dir and epoch == start_epoch:
-                window.on_step(i, sync=sync_losses)
-            with trace.span("step/device_compute"):
-                # asynchronous dispatch: host-side cost of launching the
-                # step; device execution time lands in the NEXT sync
-                # (step/loss_sync or the epoch-end mean)
-                state, loss = train_step(state, dbatch)
-            losses.append(loss)
-            m_steps.inc()
-            now_step = time.perf_counter()
-            m_step_s.observe(now_step - t_step)
-            t_step = now_step
-            faultinject.fire("step.boundary")
-            if sanitizer.is_enabled():
-                # sanitized runs are diagnostic: pay a per-step D2H sync so
-                # a non-finite loss stops IMMEDIATELY with the per-stage
-                # report + first non-finite stage, instead of averaging
-                # NaN into the epoch
-                with trace.span("step/loss_sync"):
-                    loss_last = losses.host()[-1]
-                sanitizer.check_finite_or_report(
-                    loss_last,
-                    context=f"epoch {epoch + 1} step {i + 1}",
-                )
-            if (i + 1) % log_every == 0:
-                # host() syncs on the just-appended loss, keeping the step
-                # timing honest without a second transfer of that loss
-                with trace.span("step/loss_sync"):
-                    loss_host = losses.host()[-1]
-                now = time.perf_counter()
-                ms = (now - t_last) / log_every * 1e3
-                t_last = now
-                m_step_ms.set(ms)
-                achieved = train_step_flops_for_batch(
-                    config, dbatch, from_features=from_features,
-                    trunk_trainable=train_fe or fe_finetune_blocks > 0,
-                ) / (max(ms, 1e-6) / 1e3)
-                m_mfu.set(achieved / V5E_BF16_PEAK_FLOPS)
-                m_mfu_f32.set(achieved / peak_flops("float32"))
-                print(
-                    f"epoch {epoch + 1} [{i + 1}/{len(train_loader)}] "
-                    f"loss {loss_host:.6f} ({ms:.0f} ms/step)",
-                    flush=True,
-                )
-            want_preempt = preemption is not None and preemption.requested
-            if (
-                save_every_steps and (i + 1) % save_every_steps == 0
-            ) or want_preempt:
-                # mid-epoch durable snapshot with the loader cursor; the
-                # float() syncs are confined to snapshot boundaries
-                snapshot(epoch, losses, cursor_batch=i + 1)
-            if want_preempt:
-                print(
-                    f"preempted at epoch {epoch + 1} step {i + 1}: "
-                    "checkpoint written, exiting cleanly",
-                    flush=True,
-                )
-                preempted = True
-                break
-        window.close(sync=sync_losses)  # epoch shorter than the window
-        if preempted:
-            break
-        train_loss = float(np.mean(losses.host())) if len(losses) else 0.0
-        train_hist.append(train_loss)
+            train_loss = float(np.mean(losses.host())) if len(losses) else 0.0
+            train_hist.append(train_loss)
 
-        val_loss = float("nan")
-        if val_loader is not None:
-            # collect DEVICE scalars and convert after the loop: a float()
-            # inside it would force a D2H sync per batch, serializing the
-            # validation pass against _prefetch_device_batches' H2D overlap
-            vdev = [
-                eval_step(state.params, b)
-                for b in _prefetch_device_batches(
-                    mesh, _epoch_iter(val_loader, epoch)
-                )
-            ]
-            vlosses = [float(v) for v in vdev]
-            val_loss = float(np.mean(vlosses)) if vlosses else float("nan")
-        val_hist.append(val_loss)
-        is_best = val_loss < best_val
-        best_val = min(best_val, val_loss) if not np.isnan(val_loss) else best_val
+            val_loss = float("nan")
+            if val_loader is not None:
+                # collect DEVICE scalars and convert after the loop: a float()
+                # inside it would force a D2H sync per batch, serializing the
+                # validation pass against _prefetch_device_batches' H2D overlap
+                vdev = [
+                    eval_step(state.params, b)
+                    for b in _prefetch_device_batches(
+                        mesh, _epoch_iter(val_loader, epoch)
+                    )
+                ]
+                vlosses = [float(v) for v in vdev]
+                val_loss = float(np.mean(vlosses)) if vlosses else float("nan")
+            val_hist.append(val_loss)
+            is_best = val_loss < best_val
+            best_val = min(best_val, val_loss) if not np.isnan(val_loss) else best_val
 
-        epoch_s = time.perf_counter() - t0
-        print(
-            f"epoch {epoch + 1}/{num_epochs}: train {train_loss:.6f} "
-            f"val {val_loss:.6f} ({epoch_s:.1f}s)"
-            + (" [best]" if is_best else ""),
-            flush=True,
-        )
-        # Metrics/plots stay process-0-only (tiny, host-side); the snapshot
-        # below runs on EVERY process — in sharded mode it is a collective
-        # (non-zero processes no-op out of it in the legacy layout).
-        if jax.process_index() == 0:
-            # Persisted observability (SURVEY §5: the reference is
-            # print-only; its loss arrays live only inside checkpoints):
-            # per-epoch metrics as JSONL plus a loss-curve figure, next to
-            # the checkpoint.
-            os.makedirs(checkpoint_dir, exist_ok=True)
-            with open(metrics_path, "a") as f:
-                f.write(json.dumps({
-                    "epoch": epoch + 1,
-                    "train_loss": train_loss,
-                    # strict JSON: NaN (no/empty val loader) is not valid
-                    "val_loss": None if np.isnan(val_loss) else val_loss,
-                    "epoch_seconds": round(epoch_s, 2),
-                    "steps": int(state.step),
-                    "best": bool(is_best),
-                }) + "\n")
-            try:
-                import matplotlib.pyplot as plt
+            epoch_s = time.perf_counter() - t0
+            print(
+                f"epoch {epoch + 1}/{num_epochs}: train {train_loss:.6f} "
+                f"val {val_loss:.6f} ({epoch_s:.1f}s)"
+                + (" [best]" if is_best else ""),
+                flush=True,
+            )
+            # Metrics/plots stay process-0-only (tiny, host-side); the snapshot
+            # below runs on EVERY process — in sharded mode it is a collective
+            # (non-zero processes no-op out of it in the legacy layout).
+            if jax.process_index() == 0:
+                # Persisted observability (SURVEY §5: the reference is
+                # print-only; its loss arrays live only inside checkpoints):
+                # per-epoch metrics as JSONL plus a loss-curve figure, next to
+                # the checkpoint.
+                os.makedirs(checkpoint_dir, exist_ok=True)
+                with open(metrics_path, "a") as f:
+                    f.write(json.dumps({
+                        "epoch": epoch + 1,
+                        "train_loss": train_loss,
+                        # strict JSON: NaN (no/empty val loader) is not valid
+                        "val_loss": None if np.isnan(val_loss) else val_loss,
+                        "epoch_seconds": round(epoch_s, 2),
+                        "steps": int(state.step),
+                        "best": bool(is_best),
+                    }) + "\n")
+                try:
+                    import matplotlib.pyplot as plt
 
-                from ncnet_tpu.utils.plot import plot_loss_curves, save_plot
+                    from ncnet_tpu.utils.plot import plot_loss_curves, save_plot
 
-                fig = plot_loss_curves(train_hist, val_hist)
-                save_plot(
-                    os.path.join(checkpoint_dir, "loss_curve.png"), fig=fig
-                )
-                plt.close(fig)
-            except Exception as e:  # headless plotting must never kill training
-                print(f"loss-curve plot skipped: {e}", flush=True)
-        snapshot(epoch, losses, is_best=is_best)
+                    fig = plot_loss_curves(train_hist, val_hist)
+                    save_plot(
+                        os.path.join(checkpoint_dir, "loss_curve.png"), fig=fig
+                    )
+                    plt.close(fig)
+                except Exception as e:  # headless plotting must never kill training
+                    print(f"loss-curve plot skipped: {e}", flush=True)
+            snapshot(epoch, losses, is_best=is_best)
+        clean_exit = True
+    finally:
+        if preemption is not None and hasattr(preemption, "remove_flush_hook"):
+            preemption.remove_flush_hook(preempt_flush)
+        # loop-exit barrier: join the writer. On the clean path a failed
+        # async save raises HERE (training must not outlive its
+        # durability); on the exception path close stays quiet so it
+        # never masks the error already unwinding.
+        ackpt.close(reraise=clean_exit)
     if sanitizer.is_enabled():
         print(sanitizer.report_text(), flush=True)
     return state, {
@@ -508,3 +564,4 @@ def _train_impl(
         "val_loss": val_hist,
         "preempted": preempted,
     }
+

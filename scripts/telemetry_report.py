@@ -9,10 +9,11 @@ aggregate across all processes, and metric names are tagged
 ``{proc=P}`` when more than one log contributes. Prints:
 
   * a **span table** per surface (the first path segment: ``step``,
-    ``serve``, ``eval``, ``checkpoint``, ``features``): count, total
-    seconds, SELF seconds (total minus the time attributed to child
-    spans — the span tree's exclusive time), and p50/p95/p99 of the
-    span duration;
+    ``serve``, ``eval``, ``checkpoint``, ``ckpt`` — the async handoff
+    (``ckpt/handoff``) vs writer-thread save (``ckpt/write_async``)
+    split — and ``features``): count, total seconds, SELF seconds
+    (total minus the time attributed to child spans — the span tree's
+    exclusive time), and p50/p95/p99 of the span duration;
   * a **metrics table**: final counter/gauge values and histogram
     count/sum/percentiles.
 

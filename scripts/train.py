@@ -173,6 +173,14 @@ def main():
                         "Resume reads the sharded layout when present, "
                         "else auto-migrates from the legacy single file "
                         "on the first save")
+    p.add_argument("--async-checkpoints", action="store_true",
+                   dest="async_checkpoints",
+                   help="overlap mid-epoch cursor saves with training "
+                        "(resilience.async_ckpt): the step thread hands "
+                        "the snapshot to a dedicated writer thread and "
+                        "keeps stepping; epoch-end/best/preemption saves "
+                        "still block. Crash contract unchanged — torn "
+                        "async saves are walked back like torn sync ones")
     # 'pallas' is deliberately NOT offered: the kernel lowers only in
     # interpret mode (kernels/conv4d_pallas.py STATUS) — advertising it
     # here would crash mid-training on the target hardware.
@@ -631,6 +639,7 @@ def main():
                 preemption=guard,
                 from_features=from_features,
                 distributed_checkpoints=args.distributed_checkpoints,
+                async_checkpoints=args.async_checkpoints,
             )
     finally:
         # flushes the event log + .prom snapshot on EVERY exit path, the

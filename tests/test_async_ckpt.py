@@ -1,0 +1,559 @@
+"""Async checkpointing (resilience.async_ckpt): policy, barriers, drills.
+
+Four layers, cheapest first:
+
+  * policy units — deterministic Event-gated fake writers pin the
+    coalesce / backpressure / failure-surfacing semantics with zero
+    timing dependence;
+  * concurrency audit — the fuzzed handoff run under the lock audit
+    (analysis.concurrency) asserts the writer introduces no lock-order
+    cycles and no straggler thread;
+  * loop integration — async-written checkpoints are BYTE-identical to
+    sync-written ones in both layouts (same writer code, different
+    thread — the whole point), and the PreemptionGuard flush hook is
+    registered/removed around training;
+  * subprocess drills — `os._exit` kills at every ``ackpt.*`` fault
+    point (plus `checkpoint.write` mid-async-write), proving the
+    walk-back contract and bitwise resumed == uninterrupted recovery;
+    and the satellite-6 double-SIGTERM drill: the second signal must not
+    orphan the in-flight final cursor save.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.analysis import concurrency
+from ncnet_tpu.data.loader import DataLoader
+from ncnet_tpu.data.pairs import SyntheticPairDataset
+from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+from ncnet_tpu.resilience import faultinject
+from ncnet_tpu.resilience.async_ckpt import AsyncCheckpointer, device_snapshot
+from ncnet_tpu.telemetry.registry import MetricsRegistry
+from ncnet_tpu.train.checkpoint import (
+    load_checkpoint,
+    load_latest_valid,
+    sharded_dir_for,
+)
+from ncnet_tpu.train.loop import train
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WAIT = 30.0  # generous Event timeout: a hang fails the assert, not CI
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_state():
+    yield
+    faultinject.clear()
+    concurrency.clear()
+
+
+def _ackpt(**kw):
+    kw.setdefault("registry", MetricsRegistry())
+    return AsyncCheckpointer(**kw)
+
+
+class _GatedWriter:
+    """Deterministic writer stand-in: each write records its payload and
+    thread, then blocks until `release()` — the test controls exactly
+    when the in-flight slot frees up."""
+
+    def __init__(self, gated=True):
+        self.gated = gated
+        self.gate = threading.Event()
+        self.entered = threading.Event()
+        self.written = []
+        self.threads = []
+
+    def __call__(self, data):
+        self.threads.append(threading.current_thread().name)
+        self.entered.set()
+        if self.gated and not self.gate.wait(WAIT):
+            raise RuntimeError("writer gate never released")
+        self.written.append(data)
+
+    def release(self):
+        self.gate.set()
+
+
+# --- policy units -----------------------------------------------------------
+
+
+def test_sync_mode_blocks_but_writes_on_writer_thread():
+    """satellite 1: sync SEMANTICS, but the D2H/serialize/fsync work runs
+    on the dedicated writer thread — never the step thread."""
+    w = _GatedWriter(gated=False)
+    ack = _ackpt(async_mode=False)
+    t = ack.submit(1, w, step=1)
+    assert t.done.is_set() and w.written == [1]
+    assert w.threads == ["ackpt-writer"]
+    ack.submit(2, w, step=2)
+    assert w.written == [1, 2]
+    ack.close()
+    assert ack.report()["written_total"] == 2
+    assert ack.report()["straggler_threads"] == []
+
+
+def test_overlapped_submits_coalesce_to_newest():
+    w = _GatedWriter()
+    ack = _ackpt(async_mode=True)
+    t1 = ack.submit(1, w, step=1)
+    assert w.entered.wait(WAIT)  # writer busy on save 1
+    t2 = ack.submit(2, w, step=2)  # queued
+    t3 = ack.submit(3, w, step=3)  # supersedes 2
+    t4 = ack.submit(4, w, step=4)  # supersedes 3
+    assert t2.done.is_set() and t2.superseded
+    assert t3.done.is_set() and t3.superseded
+    assert not t4.done.is_set() and not t1.superseded
+    w.release()
+    assert ack.flush(timeout=WAIT)
+    assert w.written == [1, 4], "newest queued snapshot must win"
+    rep = ack.report()
+    assert rep["coalesced_total"] == 2 and rep["written_total"] == 2
+    assert rep["submitted_total"] == 4
+    ack.close()
+
+
+def test_backpressure_mode_drops_nothing():
+    """coalesce=False (multi-process sharded runs): an overlapped submit
+    waits for the queued slot — every save executes, in order."""
+    w = _GatedWriter()
+    ack = _ackpt(async_mode=True, coalesce=False)
+    ack.submit(1, w, step=1)
+    assert w.entered.wait(WAIT)
+    ack.submit(2, w, step=2)  # queued slot free: returns immediately
+    returned = threading.Event()
+
+    def third():
+        ack.submit(3, w, step=3)
+        returned.set()
+
+    helper = threading.Thread(target=third)
+    helper.start()
+    assert not returned.wait(0.15), "submit must backpressure, not coalesce"
+    w.release()
+    assert returned.wait(WAIT)
+    helper.join(WAIT)
+    assert ack.flush(timeout=WAIT)
+    assert w.written == [1, 2, 3]
+    assert ack.report()["coalesced_total"] == 0
+    ack.close()
+
+
+def test_writer_failure_surfaces_on_next_submit():
+    def bad_write(data):
+        raise ValueError("disk on fire")
+
+    ack = _ackpt(async_mode=True)
+    ack.submit(1, bad_write, step=1)
+    assert ack.flush(timeout=WAIT, reraise=False)
+    with pytest.raises(ValueError, match="disk on fire"):
+        ack.submit(2, bad_write, step=2)
+    ack.close()  # failure already surfaced; close must not re-raise
+
+
+def test_writer_failure_surfaces_on_flush_and_close():
+    def bad_write(data):
+        raise ValueError("disk on fire")
+
+    ack = _ackpt(async_mode=True)
+    ack.submit(1, bad_write, step=1)
+    with pytest.raises(ValueError, match="disk on fire"):
+        ack.flush(timeout=WAIT)  # drains, then surfaces the failure
+    ack.close()
+
+    ack2 = _ackpt(async_mode=True)
+    ack2.submit(1, bad_write, step=1)
+    ack2.flush(timeout=WAIT, reraise=False)
+    with pytest.raises(ValueError, match="disk on fire"):
+        ack2.close(reraise=True)
+
+
+def test_sync_submit_raises_directly_and_recovers():
+    calls = []
+
+    def flaky(data):
+        calls.append(data)
+        if len(calls) == 1:
+            raise ValueError("transient")
+
+    ack = _ackpt(async_mode=False)
+    with pytest.raises(ValueError, match="transient"):
+        ack.submit(1, flaky, step=1)
+    ack.submit(2, flaky, step=2)  # the failure was consumed by the raise
+    assert calls == [1, 2]
+    ack.close(reraise=True)
+
+
+def test_flush_timeout_and_close_idempotence():
+    w = _GatedWriter()
+    ack = _ackpt(async_mode=True)
+    ack.submit(1, w, step=1)
+    assert w.entered.wait(WAIT)
+    assert ack.flush(timeout=0.05) is False
+    w.release()
+    assert ack.flush(timeout=WAIT) is True
+    ack.close()
+    ack.close()  # idempotent
+    with pytest.raises(RuntimeError, match="closed"):
+        ack.submit(2, w, step=2)
+    assert ack.report()["straggler_threads"] == []
+
+
+def test_metrics_inflight_gauge_and_coalesced_counter():
+    reg = MetricsRegistry()
+    w = _GatedWriter()
+    ack = _ackpt(async_mode=True, registry=reg)
+    ack.submit(1, w, step=1)
+    assert w.entered.wait(WAIT)
+    assert reg.gauge("ckpt_inflight").value == 1
+    ack.submit(2, w, step=2)
+    ack.submit(3, w, step=3)
+    w.release()
+    assert ack.flush(timeout=WAIT)
+    assert reg.gauge("ckpt_inflight").value == 0
+    assert reg.counter("ckpt_coalesced_total").value == 1
+    ack.close()
+
+
+def test_device_snapshot_survives_donation():
+    """The hazard device_snapshot exists for: a donating jitted update
+    invalidates the handed-off refs; the snapshot copies must not care.
+    Non-array leaves pass through by identity (byte-identity contract)."""
+    update = jax.jit(
+        lambda t: jax.tree.map(lambda x: x + 1.0, t), donate_argnums=(0,)
+    )
+    host_leaf = np.arange(3, dtype=np.float32)
+    state = {"w": jnp.arange(8, dtype=jnp.float32), "meta": host_leaf}
+    snap = device_snapshot(state)
+    assert snap["meta"] is host_leaf, "non-array leaves pass by identity"
+    state = update({"w": state["w"], "meta": jnp.zeros(())})  # donates w
+    np.testing.assert_array_equal(
+        np.asarray(snap["w"]), np.arange(8, dtype=np.float32)
+    )
+
+
+# --- concurrency audit (satellite 4) ----------------------------------------
+
+
+def test_fuzzed_handoffs_acyclic_under_lock_audit(monkeypatch):
+    """NCNET_LOCK_AUDIT=1 posture: the writer's named lock joins the
+    acquisition graph; a fuzzed mixed submit/flush workload must leave
+    the graph acyclic and the ledger straggler-free."""
+    monkeypatch.setenv(concurrency.ENV_VAR, "1")
+    concurrency.clear()
+    concurrency.enable()  # env was loaded pre-test; enable() is the reload
+    written = []
+    with concurrency.ScheduleFuzzer(seed=7, p=0.5):
+        ack = _ackpt(async_mode=True)
+        for i in range(40):
+            ack.submit(i, written.append, step=i, wait=(i % 5 == 0))
+            if i % 7 == 0:
+                assert ack.flush(timeout=WAIT)
+        assert ack.flush(timeout=WAIT)
+        ack.close()
+    assert concurrency.find_cycles() == []
+    assert ack.report()["straggler_threads"] == []
+    assert len(written) >= 9, "every wait=True submit must have executed"
+    # the audited name was actually exercised, from both sides
+    stats = concurrency.held_stats()
+    assert stats.get("resilience.ackpt", {}).get("acquires", 0) > 0
+
+
+# --- loop integration -------------------------------------------------------
+
+# the pinned kill-drill schedule (tests/conftest.py session fixtures)
+N_PAIRS, BATCH, EPOCHS, SIZE = 8, 2, 2, 32
+STEPS_PER_EPOCH = N_PAIRS // BATCH
+CFG = ImMatchNetConfig(ncons_kernel_sizes=(3,), ncons_channels=(1,))
+
+
+def _loader():
+    ds = SyntheticPairDataset(n=N_PAIRS, output_size=(SIZE, SIZE), seed=11)
+    return DataLoader(ds, BATCH, shuffle=True, seed=5, drop_last=True,
+                      num_workers=1, prefetch=0)
+
+
+def _run(ckdir, **train_kw):
+    params = init_immatchnet(jax.random.PRNGKey(0), CFG)
+    kw = dict(
+        num_epochs=EPOCHS, checkpoint_dir=str(ckdir), data_parallel=False,
+        log_every=100, save_every_steps=2, keep_checkpoints=4,
+        async_checkpoints=True,
+    )
+    kw.update(train_kw)
+    return train(CFG, kw.pop("params", params), _loader(), None, **kw)
+
+
+def _resume(ckdir, **train_kw):
+    ck, _ = load_latest_valid(os.path.join(str(ckdir), "ncnet_tpu.msgpack"))
+    kw = dict(
+        params=ck.params,
+        opt_state=ck.opt_state,
+        start_epoch=ck.epoch,
+        start_step=ck.step,
+        initial_best_val=ck.best_val_loss,
+        initial_train_hist=ck.train_loss,
+        initial_val_hist=ck.val_loss,
+    )
+    if ck.cursor:
+        kw["start_epoch"] = ck.cursor["epoch"]
+        kw["start_batch"] = ck.cursor["batch_index"]
+        kw["start_epoch_losses"] = ck.cursor["epoch_losses"]
+    kw.update(train_kw)
+    return _run(ckdir, **kw), ck
+
+
+def _assert_bitwise_equal(ck_a, ck_b):
+    flat_a, _ = jax.tree_util.tree_flatten_with_path(ck_a.params)
+    flat_b, _ = jax.tree_util.tree_flatten_with_path(ck_b.params)
+    assert len(flat_a) == len(flat_b)
+    for (path_a, leaf_a), (_, leaf_b) in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(
+            np.asarray(leaf_a), np.asarray(leaf_b),
+            err_msg=f"params differ at {jax.tree_util.keystr(path_a)}",
+        )
+    for a, b in zip(
+        jax.tree.leaves(ck_a.opt_state), jax.tree.leaves(ck_b.opt_state)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(ck_a.step) == int(ck_b.step)
+    np.testing.assert_array_equal(
+        np.asarray(ck_a.train_loss), np.asarray(ck_b.train_loss)
+    )
+
+
+def _metrics_lines(ckdir):
+    with open(os.path.join(str(ckdir), "metrics.jsonl")) as f:
+        return [json.loads(line) for line in f]
+
+
+def _assert_metrics_tails_match(lines_ref, lines_run):
+    """The uninterrupted run's metrics must be the SUFFIX of the run's
+    (modulo wall-clock). A kill landing between the epoch metrics append
+    and the epoch-end checkpoint commit resumes from the last mid-epoch
+    cursor, so the epoch line is legitimately re-appended — the resumed
+    line must still match the uninterrupted one exactly."""
+    strip = lambda l: {k: v for k, v in l.items() if k != "epoch_seconds"}
+    ref, run = [strip(l) for l in lines_ref], [strip(l) for l in lines_run]
+    assert len(run) >= len(ref)
+    assert run[-len(ref):] == ref
+
+
+def test_async_training_byte_identical_legacy(tmp_path, legacy_format_run):
+    """Async vs sync legacy layout: the FINAL checkpoint file must be
+    byte-for-byte identical (same serialization, same durable writer —
+    only the thread changed); the writer thread must be gone at return
+    (loop-exit close barrier / thread ledger)."""
+    _run(tmp_path)  # async arm of the A/B; fixture ran the sync arm
+    assert not [
+        t for t in threading.enumerate() if t.name == "ackpt-writer"
+    ], "loop exit must join the checkpoint writer"
+    ck_sync, lines_sync, sync_dir = legacy_format_run
+    a = open(os.path.join(str(tmp_path), "ncnet_tpu.msgpack"), "rb").read()
+    b = open(os.path.join(str(sync_dir), "ncnet_tpu.msgpack"), "rb").read()
+    assert a == b, "async-written checkpoint differs from sync bytes"
+    ck_async = load_checkpoint(os.path.join(str(tmp_path), "ncnet_tpu.msgpack"))
+    _assert_bitwise_equal(ck_async, ck_sync)
+    _assert_metrics_tails_match(_metrics_lines(tmp_path), lines_sync)
+
+
+def test_async_training_byte_identical_sharded(tmp_path, uninterrupted_run):
+    """Async vs sync sharded layout: the final committed step directory
+    must match file-by-file (chunks, manifests, MANIFEST.json)."""
+    _run(tmp_path, distributed_checkpoints=True)
+    _, lines_sync, sync_dir = uninterrupted_run
+
+    def final_step_dir(ckdir):
+        sdir = sharded_dir_for(os.path.join(str(ckdir), "ncnet_tpu.msgpack"))
+        steps = [
+            d for d in os.listdir(sdir)
+            if d.startswith("step_")
+            and os.path.exists(os.path.join(sdir, d, "MANIFEST.json"))
+        ]
+        return os.path.join(sdir, max(steps))
+
+    da, db = final_step_dir(tmp_path), final_step_dir(sync_dir)
+    assert os.path.basename(da) == os.path.basename(db)
+
+    def tree_files(root):
+        out = {}
+        for dirpath, _, names in os.walk(root):
+            for n in names:
+                p = os.path.join(dirpath, n)
+                out[os.path.relpath(p, root)] = open(p, "rb").read()
+        return out
+
+    fa, fb = tree_files(da), tree_files(db)
+    assert sorted(fa) == sorted(fb)
+    for rel in fa:
+        assert fa[rel] == fb[rel], f"sharded file differs async vs sync: {rel}"
+    _assert_metrics_tails_match(_metrics_lines(tmp_path), lines_sync)
+
+
+def test_preemption_registers_flush_hook_and_commits_cursor(tmp_path):
+    """The loop wires its flush barrier into the guard's second-signal
+    path for the life of training (and unwires it after); the preemption
+    final save is committed by the time train() returns."""
+
+    class _HookGuard:
+        def __init__(self, after_steps):
+            self.after = after_steps
+            self.seen = 0
+            self.added = []
+            self.removed = []
+
+        @property
+        def requested(self):
+            return self.seen >= self.after
+
+        def add_flush_hook(self, hook):
+            self.added.append(hook)
+
+        def remove_flush_hook(self, hook):
+            self.removed.append(hook)
+
+    guard = _HookGuard(after_steps=STEPS_PER_EPOCH + 1)
+    real_fire = faultinject.fire
+
+    def counting_fire(point, data=None):
+        if point == "step.boundary":
+            guard.seen += 1
+        return real_fire(point, data)
+
+    patch = pytest.MonkeyPatch()
+    patch.setattr("ncnet_tpu.train.loop.faultinject.fire", counting_fire)
+    try:
+        _, history = _run(tmp_path, preemption=guard)
+    finally:
+        patch.undo()
+    assert history["preempted"]
+    assert len(guard.added) == 1 and guard.removed == guard.added
+    ck = load_checkpoint(os.path.join(str(tmp_path), "ncnet_tpu.msgpack"))
+    assert ck.cursor is not None and ck.cursor["batch_index"] == 1
+
+
+# --- subprocess kill drills -------------------------------------------------
+
+
+def _train_script(ckdir, epochs=EPOCHS, save_every=2, preempt=False):
+    guard_import = (
+        "from ncnet_tpu.resilience.signals import PreemptionGuard\n"
+        if preempt else ""
+    )
+    enter = "with PreemptionGuard() as guard:\n    " if preempt else ""
+    kw = ", preemption=guard" if preempt else ""
+    return f"""
+import sys
+sys.path.insert(0, {REPO!r})
+import jax
+from ncnet_tpu.data.loader import DataLoader
+from ncnet_tpu.data.pairs import SyntheticPairDataset
+from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+from ncnet_tpu.train.loop import train
+{guard_import}
+cfg = ImMatchNetConfig(ncons_kernel_sizes=(3,), ncons_channels=(1,))
+ds = SyntheticPairDataset(n={N_PAIRS}, output_size=({SIZE}, {SIZE}), seed=11)
+loader = DataLoader(ds, {BATCH}, shuffle=True, seed=5, drop_last=True,
+                    num_workers=1, prefetch=0)
+params = init_immatchnet(jax.random.PRNGKey(0), cfg)
+{enter}train(cfg, params, loader, None, num_epochs={epochs},
+      checkpoint_dir={str(ckdir)!r}, data_parallel=False, log_every=100,
+      save_every_steps={save_every}, keep_checkpoints=4,
+      async_checkpoints=True{kw})
+raise SystemExit("unreachable: the injected fault did not fire")
+"""
+
+
+# hit indices chosen so a COMMITTED save provably precedes the kill:
+# ackpt.handoff fires per submit on the step thread — hit 4 is the first
+# epoch-2 submit, after the epoch-1-end save (wait=True) committed; the
+# writer-side points fire per executed save on the single writer thread,
+# so at hit 2 execution 1 has already committed. checkpoint.write=kill
+# is the mid-async-write drill: the kill lands inside the durable temp
+# write ON THE WRITER THREAD, leaving a torn temp file behind.
+@pytest.mark.parametrize("fault", [
+    "ackpt.handoff=kill@4",
+    "ackpt.d2h=kill@2",
+    "ackpt.write=kill@2",
+    "ackpt.commit=kill@2",
+    "checkpoint.write=kill@2",
+])
+def test_kill_drill_walks_back_and_resumes_bitwise(
+    tmp_path, fault, legacy_format_run
+):
+    proc = subprocess.run(
+        [sys.executable, "-c", _train_script(tmp_path)],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "NCNET_FAULTS": fault},
+    )
+    assert proc.returncode == 137, (fault, proc.stderr[-2000:])
+    if fault.startswith("checkpoint.write"):
+        tmps = [n for n in os.listdir(tmp_path) if ".tmp." in n]
+        assert tmps, "mid-async-write kill should leave a torn temp file"
+    if fault.startswith("ackpt.commit"):
+        # the kill landed AFTER the durable write returned: that save is
+        # committed and recovery must land on it, not walk past it
+        ck, _ = load_latest_valid(
+            os.path.join(str(tmp_path), "ncnet_tpu.msgpack")
+        )
+        assert int(ck.step) >= 2
+
+    (_, history), _ = _resume(tmp_path)
+    assert not history["preempted"]
+    ck_sync, lines_sync, _ = legacy_format_run
+    ck_b = load_checkpoint(os.path.join(str(tmp_path), "ncnet_tpu.msgpack"))
+    _assert_bitwise_equal(ck_sync, ck_b)
+    _assert_metrics_tails_match(lines_sync, _metrics_lines(tmp_path))
+
+
+def test_double_sigterm_does_not_orphan_inflight_save(tmp_path):
+    """satellite 6: second SIGTERM mid-async-final-save — the guard's
+    flush hook gives the in-flight cursor save its bounded grace, so the
+    process dies BY SIGTERM but latest_valid() still lands on the
+    committed final cursor save."""
+    ckpath = os.path.join(str(tmp_path), "ncnet_tpu.msgpack")
+    body = _train_script(tmp_path, epochs=3, save_every=0, preempt=True)
+    script = f"""
+import os, signal, threading, time
+import sys
+sys.path.insert(0, {REPO!r})
+from ncnet_tpu.resilience import faultinject
+
+# every durable save takes >= 1.5s on the writer: the second SIGTERM
+# below provably lands while the final cursor save is still in flight
+faultinject.configure("ackpt.write=delay:1.5")
+
+def killer():
+    while not os.path.exists({ckpath!r}):
+        time.sleep(0.02)
+    os.kill(os.getpid(), signal.SIGTERM)   # request preemption
+    time.sleep(1.0)
+    os.kill(os.getpid(), signal.SIGTERM)   # impatient operator, mid-save
+threading.Thread(target=killer, daemon=True).start()
+{body}
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=300, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == -signal.SIGTERM, (
+        proc.returncode, proc.stderr[-2000:]
+    )
+    out = proc.stdout + proc.stderr
+    assert "will checkpoint at the next step boundary" in out
+    ck, _ = load_latest_valid(ckpath)
+    assert ck.cursor is not None, (
+        "double SIGTERM orphaned the in-flight final cursor save"
+    )
