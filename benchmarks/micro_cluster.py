@@ -1,0 +1,142 @@
+"""Microbench: steady-state overhead of cluster supervision on the step path.
+
+The supervisor's contract (ncnet_tpu/resilience/cluster.py) is that
+health supervision rides the step loop for ~free: heartbeats and peer
+monitoring run on their own daemon threads, and the ONLY per-boundary
+costs a training step pays are
+
+  check          — `ClusterSupervisor.check`: one lock + dict look at the
+                   monitor's declared-dead map (no filesystem I/O; the
+                   monitor thread pays that);
+  stop_requested — the durable stop-flag poll: a set-event short-circuit
+                   or one throttled ``os.path.exists`` per ``stop_poll_s``
+                   (steady state: a monotonic clock read);
+  consensus      — one `agree_save_cursor` propose/ack ROUND WALL, paid
+                   once per overlapped-save attempt (every
+                   ``save_every_steps`` boundaries, not every step) and
+                   only in async+multi-process runs.
+
+This bench pins those with numbers against a LIVE 2-supervisor pair
+(heartbeat + monitor threads running, shared tmpdir rendezvous — the
+real medium), then derives the acceptance ratio:
+
+  overhead_pct = (check + stop_requested
+                  + round_wall / save_every) / step_wall * 100
+
+which must stay < 1% of step wall (ISSUE 20; --step-wall-ms defaults to
+the B=4 TPU step from benchmarks/PERF.md, override to match your box).
+Prints one JSON line. Pure host bench: no jax, no device.
+
+Usage:
+  python benchmarks/micro_cluster.py [--iters 100000] [--rounds 50]
+      [--step-wall-ms 35.0] [--save-every 100]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ncnet_tpu.resilience.cluster import ClusterSupervisor  # noqa: E402
+from ncnet_tpu.telemetry.registry import MetricsRegistry  # noqa: E402
+
+
+def _per_op_ns(fn, iters, repeats=5):
+    """min-of-repeats per-op nanoseconds (min discards scheduler noise)."""
+    best = min(fn(iters) for _ in range(repeats))
+    return best / iters * 1e9
+
+
+def _bench_call(call):
+    def run(iters):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            call()
+        return time.perf_counter() - t0
+
+    return run
+
+
+def _consensus_round_ms(s0, s1, rounds):
+    """Median wall of a full 2-party propose/ack round (leader + follower
+    driven concurrently, the loop's real shape)."""
+    walls = []
+    for r in range(rounds):
+        step = 2 * (r + 1)
+        out = {}
+        follower = threading.Thread(
+            target=lambda: out.__setitem__("f", s1.agree_save_cursor(step, False))
+        )
+        t0 = time.perf_counter()
+        follower.start()
+        out["l"] = s0.agree_save_cursor(step, False)
+        follower.join()
+        walls.append(time.perf_counter() - t0)
+        if not (out["l"] and out["f"]):
+            raise RuntimeError(f"consensus round {r} did not agree SAVE: {out}")
+    walls.sort()
+    return walls[len(walls) // 2] * 1e3
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=100_000)
+    p.add_argument("--rounds", type=int, default=50)
+    p.add_argument("--step-wall-ms", type=float, default=35.0,
+                   dest="step_wall_ms",
+                   help="step wall to ratio against (default: the B=4 "
+                        "train step from benchmarks/PERF.md)")
+    p.add_argument("--save-every", type=int, default=100, dest="save_every",
+                   help="boundaries per overlapped save attempt — the "
+                        "consensus round amortizes over this")
+    args = p.parse_args()
+
+    with tempfile.TemporaryDirectory(prefix="micro_cluster_") as base:
+        sups = [
+            ClusterSupervisor(
+                base, p_, 2,
+                heartbeat_interval_s=0.5, staleness_s=60.0,
+                poll_interval_s=0.002, registry=MetricsRegistry(),
+            ).start()
+            for p_ in range(2)
+        ]
+        s0, s1 = sups
+        time.sleep(1.0)  # both monitors see live heartbeats
+
+        check_ns = _per_op_ns(
+            _bench_call(lambda: s0.check("bench boundary")), args.iters
+        )
+        stop_ns = _per_op_ns(_bench_call(s0.stop_requested), args.iters)
+        round_ms = _consensus_round_ms(s0, s1, args.rounds)
+
+        for s in sups:
+            s.close()
+        for s in sups:
+            if s.report()["straggler_threads"]:
+                raise RuntimeError(f"straggler threads: {s.report()}")
+
+    boundary_ms = (check_ns + stop_ns) / 1e6
+    per_step_ms = boundary_ms + round_ms / max(args.save_every, 1)
+    overhead_pct = per_step_ms / args.step_wall_ms * 100
+
+    print(json.dumps({
+        "iters": args.iters,
+        "rounds": args.rounds,
+        "check_ns": round(check_ns, 1),
+        "stop_requested_ns": round(stop_ns, 1),
+        "consensus_round_ms": round(round_ms, 3),
+        "save_every": args.save_every,
+        "step_wall_ms": args.step_wall_ms,
+        "per_step_overhead_ms": round(per_step_ms, 6),
+        # the acceptance number: must stay < 1.0
+        "overhead_pct_of_step": round(overhead_pct, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,35 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+# --- collective health checking ----------------------------------------------
+#
+# A dead peer turns any cross-process array assembly into an indefinite
+# gloo/DCN hang. The training loop installs its cluster supervisor's
+# `check` here (resilience.cluster, train.loop); the multi-process
+# branches below call `checked_collective` at entry so a stale-heartbeat
+# peer raises a typed `PeerDown` BEFORE this host commits to a transfer
+# that can never complete. Single-process runs (and runs without a
+# supervisor) are untouched — the hook is None and the call is a no-op.
+
+_collective_check = None  # set only from the step thread (set_collective_check)
+
+
+def set_collective_check(fn):
+    """Install ``fn(what)`` to run before every cross-process collective
+    in this module; pass None to uninstall. Returns the previous hook so
+    the training loop can restore it on exit."""
+    global _collective_check
+    prev = _collective_check
+    _collective_check = fn
+    return prev
+
+
+def checked_collective(what):
+    """Run the installed health check (if any) before a collective."""
+    if _collective_check is not None:
+        _collective_check(what)
+
+
 def make_mesh(mesh_shape=None, axis_names=("data",), devices=None):
     """Create a mesh. Default: all devices on a single ``data`` axis."""
     if devices is None:
@@ -191,6 +220,7 @@ def shard_batch(mesh, batch, axis="data"):
     """
     sharding = NamedSharding(mesh, P(axis))
     if jax.process_count() > 1:
+        checked_collective("shard_batch global-array assembly")
         return jax.tree.map(
             lambda x: jax.make_array_from_process_local_data(
                 sharding, np.asarray(x)
@@ -246,6 +276,8 @@ def replicate(mesh, tree):
     """
     sharding = NamedSharding(mesh, P())
     if jax.process_count() > 1:
+        checked_collective("replicate global-array assembly")
+
         def rep(x):
             x = np.asarray(x)
             locals_ = [

@@ -31,11 +31,24 @@ Policy — at most one save in flight, one queued:
     blocks: if the queued slot is occupied, the older queued-not-started
     snapshot is COALESCED into the newer one (newest state wins; counted
     in ``ckpt_coalesced_total``);
-  * with ``coalesce=False`` (multi-process sharded runs, where a
-    collective save skipped on one host would wedge the others at the
-    commit barrier) nothing is ever dropped: an overlapped submit
-    backpressures — it waits for the queued slot, so every process
-    writes the same save sequence in the same order.
+  * with ``coalesce=False`` (multi-process sharded runs WITHOUT a
+    cluster supervisor, where a collective save skipped on one host
+    would wedge the others at the commit barrier) nothing is ever
+    dropped: an overlapped submit backpressures — it waits for the
+    queued slot, so every process writes the same save sequence in the
+    same order;
+  * with a ``coalesce_arbiter`` (multi-process sharded runs WITH
+    `resilience.cluster` — its ``agree_save_cursor``), skipping becomes
+    the collective decision it has to be: before enqueueing, an
+    overlapped submit asks the arbiter whether ANY host's queue is busy;
+    if so, every host drops this snapshot at once (counted in
+    ``ckpt_coalesced_total`` — coalescing regained for multi-process,
+    and since the round is collective the save SETS stay identical). A
+    skip drops the NEWER snapshot (the queued older one still commits);
+    superseding in place would itself need consensus. Blocking submits
+    bypass the arbiter (they are part of the deterministic schedule on
+    every host) and backpressure instead of superseding, for the same
+    divergence reason.
 
 ``flush()`` barriers at epoch end, at the `PreemptionGuard` final save
 (via its second-signal flush hooks, resilience/signals.py), and at loop
@@ -63,11 +76,36 @@ by the package ``__init__``; the training loop imports it directly.
 """
 
 import threading
+import weakref
 
 from ncnet_tpu.analysis import concurrency
 from ncnet_tpu.resilience import faultinject
 from ncnet_tpu.telemetry import trace
 from ncnet_tpu.telemetry.registry import default_registry
+
+# Live-instance registry so a topology-changing restore can flush every
+# active writer before reading (checkpoint.load_latest_valid_* — an
+# in-flight async save otherwise races the restore's directory walk).
+# lock-order: ackpt.live is never held across a flush (snapshot inside,
+# flush outside), so no ordering against the per-instance _cv exists.
+_live_lock = concurrency.make_lock("resilience.ackpt.live")
+_live = weakref.WeakSet()  # guarded-by: _live_lock
+
+
+def flush_live_checkpointers(timeout=60.0):
+    """Flush every live `AsyncCheckpointer` (best-effort, never raises).
+
+    Called by the restore paths before reading a checkpoint directory:
+    a restore that overlaps an in-flight async save must not observe the
+    save mid-write nor deadlock against it. Returns False if any flush
+    timed out.
+    """
+    with _live_lock:
+        live = list(_live)
+    drained = True
+    for ckpt in live:
+        drained = ckpt.flush(timeout=timeout, reraise=False) and drained
+    return drained
 
 
 def device_snapshot(tree):
@@ -121,9 +159,10 @@ class AsyncCheckpointer:
     # private bare lock, so no cross-module ordering is introduced.)
 
     def __init__(self, async_mode=True, coalesce=True, join_timeout=60.0,
-                 registry=None):
+                 registry=None, coalesce_arbiter=None):
         self._async = bool(async_mode)
         self._coalesce = bool(coalesce)
+        self._arbiter = coalesce_arbiter  # called on the step thread only
         self._join_timeout = join_timeout
         self._lock = concurrency.make_lock("resilience.ackpt")
         self._cv = threading.Condition(self._lock)
@@ -134,6 +173,7 @@ class AsyncCheckpointer:
         self._submitted = 0  # guarded-by: _cv
         self._written = 0  # guarded-by: _cv
         self._coalesced = 0  # guarded-by: _cv
+        self._consensus_skips = 0  # guarded-by: _cv
         reg = registry if registry is not None else default_registry()
         self._m_inflight = reg.gauge(
             "ckpt_inflight", "checkpoint saves currently in flight (0/1)"
@@ -150,6 +190,8 @@ class AsyncCheckpointer:
             threading.Thread(target=self._writer_loop, name="ackpt-writer")
         ]
         self._thread_ledger[0].start()
+        with _live_lock:
+            _live.add(self)
 
     # --- step-thread side ----------------------------------------------------
 
@@ -167,16 +209,50 @@ class AsyncCheckpointer:
         ticket = _Ticket(data, prepare, write, step)
         with trace.span("ckpt/handoff"):
             faultinject.fire("ackpt.handoff")
+            if self._arbiter is not None and not wait:
+                # collective coalescing: ask the cluster whether any
+                # host's queue is busy. Reading _queued without holding
+                # the lock across the (filesystem) round is safe under
+                # the single-producer contract: only this thread can
+                # OCCUPY the slot, so free stays free; occupied draining
+                # to free mid-round just makes the skip conservative —
+                # and identical on every host, since the LEADER decides
+                # from the proposals. The arbiter runs outside the lock
+                # (it blocks on peers and may raise a typed PeerDown).
+                with self._cv:
+                    self._raise_failure_locked()
+                    if self._closed:
+                        raise RuntimeError(
+                            "AsyncCheckpointer is closed; no further snapshots"
+                        )
+                    busy = self._queued is not None
+                if not self._arbiter(int(step), busy):
+                    # every host drops this snapshot together; the queued
+                    # older one still commits (oldest-wins under
+                    # consensus — the docstring's freshness trade)
+                    with self._cv:
+                        self._consensus_skips += 1
+                        self._coalesced += 1
+                        self._m_coalesced.inc()
+                    ticket.superseded = True
+                    ticket.done.set()
+                    return ticket
+                # SAVE decided => every host's queue was free, ours
+                # included (single producer: still free) — plain enqueue
             with self._cv:
                 self._raise_failure_locked()
                 if self._closed:
                     raise RuntimeError(
                         "AsyncCheckpointer is closed; no further snapshots"
                     )
-                if self._queued is not None and not self._coalesce:
+                if self._queued is not None and (
+                    not self._coalesce or self._arbiter is not None
+                ):
                     # deterministic-collective mode: never drop a save —
                     # wait for the slot so every process writes the same
-                    # sequence (multi-process sharded commit barrier)
+                    # sequence (multi-process sharded commit barrier).
+                    # Under an arbiter this is the wait=True path: a
+                    # local supersede here would diverge the save sets.
                     while self._queued is not None and self._failure is None:
                         self._cv.wait()
                     self._raise_failure_locked()
@@ -240,6 +316,8 @@ class AsyncCheckpointer:
         for t in self._thread_ledger:
             if t.is_alive():
                 t.join(self._join_timeout)
+        with _live_lock:
+            _live.discard(self)
         if reraise:
             with self._cv:
                 self._raise_failure_locked()
@@ -256,6 +334,8 @@ class AsyncCheckpointer:
             return {
                 "async_mode": self._async,
                 "coalesce": self._coalesce,
+                "consensus": self._arbiter is not None,
+                "consensus_skips_total": self._consensus_skips,
                 "submitted_total": self._submitted,
                 "written_total": self._written,
                 "coalesced_total": self._coalesced,

@@ -1,0 +1,759 @@
+"""Filesystem-rendezvous cluster supervision for multi-host training.
+
+A multi-host run (resilience.distributed two-phase-commit saves over a
+shared checkpoint directory) survives every SINGLE-host failure we can
+inject, but the hosts have no view of EACH OTHER: a dead peer turns the
+next collective (a gloo transfer, a commit barrier) into an indefinite
+hang with no typed failure; a SIGTERM delivered to one host never
+reaches the others; and skipping an async save is a collective decision
+no host can make alone, so multi-process runs had to disable coalescing.
+This module is the coordination layer, using the same medium the saves
+already trust — durable files in a shared directory (no new transport,
+no new deps; the ``dckpt`` barrier discipline applied to liveness):
+
+  <cluster_dir>/
+    gen000/                              one directory per cluster GENERATION
+      hb_proc00000.json (+ .sha256)      per-host heartbeats (seq + hostname),
+      hb_proc00001.json                  rewritten atomically every interval
+      stop_request.json                  the durable stop flag (any host)
+      stop_ack_proc00000.json            "saw the flag at step boundary B"
+      stop_go.json                       leader's agreed drain step (max of acks)
+      rounds/
+        r000000_proc00000.json           save-cursor consensus: proposals
+        r000000_decision.json            ... and the leader's save/skip verdict
+    gen001/...                           re-formed topology after a PeerDown
+    reform_gen001_proc00000.json         elastic re-formation rendezvous
+    coord_gen001.json                    survivor rank 0's new coordinator
+
+Four capabilities:
+
+  * **Health supervision** — every host beats ``hb_proc<P>`` on a writer
+    thread; a monitor thread tracks peer beat SEQUENCE changes against
+    its own monotonic clock (no cross-host clock sync needed) and
+    declares a peer dead after ``staleness_s`` without a change.
+    ``check()`` then raises a typed :class:`PeerDown` — the deadline
+    check collective call sites run instead of hanging: the training
+    loop at every step boundary, `parallel.mesh.checked_collective` at
+    every cross-process array assembly, and the sharded-save barrier
+    polls via ``save_sharded(health_check=...)``. Detection latency is
+    bounded by ``staleness_s`` + one monitor poll. The budget must also
+    cover startup skew; start the supervisor only AFTER
+    ``jax.distributed.initialize`` has barriered the processes.
+  * **Coordinated preemption** — `publish_stop` durably publishes
+    ``stop_request.json`` (`PreemptionGuard(cluster=...)` calls it from
+    the signal handler, lock-free, so a signal on ANY host reaches all).
+    Each host polls the flag at step boundaries (`stop_requested`, a
+    throttled stat) and then drives `drain_step` — a NON-BLOCKING state
+    machine: ack the flag with the current step and KEEP TRAINING
+    (including the regular collective save schedule — flag visibility
+    skews across hosts, and a host blocked waiting for acks while a
+    peer enters a collective save barrier would deadlock the run; the
+    collective schedule is also what bounds inter-host step skew to one
+    save interval). Once all acks are in, the leader publishes
+    ``stop_go`` with a drain step safely AHEAD of every host
+    (``max(acks) + save interval + 2``); each host picks it up at a
+    later boundary, trains up to exactly that step, and writes the
+    final cursor save there — every host commits the SAME final step.
+  * **Save-cursor consensus** — `agree_save_cursor(step, busy)` is the
+    `AsyncCheckpointer` coalesce arbiter for multi-process sharded runs:
+    each host durably proposes whether its writer is busy; the leader
+    decides SKIP if any host is (no host backpressures — the coalescing
+    win) and SAVE only when all are free, so every host skips or saves
+    the same step, deterministically, and the commit barrier can never
+    see divergent save sequences. Note the freshness trade: a collective
+    skip drops the NEWER snapshot (the queued older one still gets
+    written) — superseding in place would itself need consensus.
+  * **Elastic restart** — :class:`ElasticSupervisor` runs the training
+    process as a child; a child exiting :data:`EXIT_PEER_DOWN` (the
+    typed `PeerDown` exit, ``scripts/train.py --elastic``) triggers
+    re-formation: survivors rendezvous via ``reform_gen<G>_proc<P>``
+    files inside a bounded window, re-rank by original process index,
+    survivor rank 0 publishes a fresh coordinator, and the children
+    relaunch at the surviving topology — resuming from
+    ``latest_valid_save`` through the topology-independent `SaveReader`
+    restore. A host that misses the window is excluded (bounded-join
+    semantics, the standard elastic-agent trade).
+
+Fault points (`resilience.faultinject`): ``cluster.heartbeat`` (writer
+thread, before each beat — a kill is a dying host the peers must
+detect), ``cluster.stopflag`` (before the stop flag publishes — a kill
+loses the drain request), ``cluster.propose`` / ``cluster.ack`` (before
+a consensus proposal / the leader's decision write — a kill mid-round
+must surface as `PeerDown` on the peers, not a hang).
+
+Threading: the heartbeat and monitor threads are ledger-tracked and
+joined by `close()` under a bounded budget (`report()` lists stragglers
+once closed, the serve-engine convention). Cross-thread state (the
+peer-liveness maps) is guarded by one named lock; the drain/consensus
+state machines run only on the step thread (the `AsyncCheckpointer`
+single-producer contract extends to its arbiter), and `publish_stop` /
+`stop_requested` are lock-free so the signal handler can never deadlock
+against a step-thread wait.
+
+Stdlib-only (the `resilience` import-light contract): topology is passed
+in explicitly (``process_index``/``process_count``), never read from jax.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from ncnet_tpu.analysis import concurrency
+from ncnet_tpu.resilience import durable, faultinject
+from ncnet_tpu.telemetry.registry import default_registry
+
+#: the typed "peer died, re-form and resume" exit status the elastic
+#: supervisor restarts on (EX_TEMPFAIL; anything else propagates)
+EXIT_PEER_DOWN = 75
+
+
+class ClusterError(RuntimeError):
+    """A cluster protocol step failed (timeout, malformed rendezvous)."""
+
+
+class PeerDown(ClusterError):
+    """A peer host's heartbeat went stale past the staleness budget.
+
+    ``host`` is the peer's process index; ``last_seen`` is how many
+    seconds ago its heartbeat last changed (None: never seen at all).
+    Raised by `ClusterSupervisor.check` — i.e. at step boundaries, at
+    collective entry, and inside barrier/consensus waits — so a dead
+    peer surfaces as a typed failure instead of a hung collective.
+    """
+
+    def __init__(self, host, last_seen, budget=None, where=None):
+        self.host = int(host)
+        self.last_seen = last_seen
+        self.budget = budget
+        ago = (
+            f"last heartbeat {last_seen:.1f}s ago"
+            if last_seen is not None
+            else "no heartbeat ever observed"
+        )
+        at = f" at {where}" if where else ""
+        super().__init__(
+            f"peer {self.host} down{at}: {ago}"
+            + (f" (staleness budget {budget}s)" if budget is not None else "")
+        )
+
+
+def _proc_tag(p):
+    return f"proc{int(p):05d}"
+
+
+def _write_json(path, payload):
+    # the same temp+fsync+rename discipline as every other rendezvous
+    # file; the checkpoint.* fault windows stay out of cluster traffic
+    # (cluster.* points fire at the call sites, per protocol phase)
+    durable.durable_write_bytes(
+        path,
+        json.dumps(payload, sort_keys=True).encode("utf-8"),
+        write_point=None,
+        rename_point=None,
+        bytes_point=None,
+    )
+
+
+def _read_json(path):
+    """Parse a rendezvous file, or None while it is absent/not-yet-whole.
+
+    Writers publish via atomic rename, so a reader sees old-or-new bytes,
+    never a mixture; the digest SIDECAR however lands in a second rename,
+    so (unlike checkpoint loads) liveness reads must not require it."""
+    try:
+        with open(path, "rb") as f:
+            return json.loads(f.read().decode("utf-8"))
+    except (FileNotFoundError, ValueError, OSError):
+        return None
+
+
+class ClusterSupervisor:
+    """Heartbeats + peer-death detection + stop-flag drain + save-cursor
+    consensus over a shared directory (module docstring has the layout).
+
+    Use as a context manager or call `start()`/`close()` explicitly;
+    `close()` joins the heartbeat and monitor threads under a bounded
+    budget and `report()["straggler_threads"]` must be empty after it.
+    """
+
+    def __init__(
+        self,
+        base_dir,
+        process_index,
+        process_count,
+        generation=0,
+        heartbeat_interval_s=2.0,
+        staleness_s=15.0,
+        consensus_timeout_s=120.0,
+        poll_interval_s=0.05,
+        stop_poll_s=0.25,
+        join_timeout_s=10.0,
+        registry=None,
+    ):
+        self._p = int(process_index)
+        self._n = int(process_count)
+        self._gen = int(generation)
+        self._base = os.path.abspath(base_dir)
+        self._dir = os.path.join(self._base, f"gen{self._gen:03d}")
+        self._rounds_dir = os.path.join(self._dir, "rounds")
+        self._interval = float(heartbeat_interval_s)
+        self._staleness = float(staleness_s)
+        self._consensus_timeout = float(consensus_timeout_s)
+        self._poll = float(poll_interval_s)
+        self._stop_poll_s = float(stop_poll_s)
+        self._join_timeout = float(join_timeout_s)
+        self._peers = [q for q in range(self._n) if q != self._p]
+
+        # lock-order: _lock
+        # (a leaf: nothing is ever acquired while held, and publish_stop
+        # is lock-free because a signal handler may interrupt a thread
+        # that holds it)
+        self._lock = concurrency.make_lock("resilience.cluster")
+        self._last = {}  # guarded-by: _lock  (peer -> [seq, mono_of_change])
+        self._dead = {}  # guarded-by: _lock  (peer -> age_s when declared)
+        self._started_at = None  # set once in start(), read-only after
+        self._closed_evt = threading.Event()
+        self._started = False
+
+        # drain + consensus state machines run ONLY on the step thread
+        # (the AsyncCheckpointer single-producer contract extends to its
+        # arbiter), so these fields need no lock; the signal handler
+        # touches only the lock-free _stop_local event below.
+        self._stop_acked_at = None  # step-thread only
+        self._drain_at = None  # step-thread only
+        self._round = 0  # step-thread only
+        self._stop_local = threading.Event()
+        self._stop_poll_last = 0.0  # step-thread only (poll throttle)
+
+        reg = registry if registry is not None else default_registry()
+        self._m_hb_age = reg.gauge(
+            "cluster_heartbeat_age_s",
+            "seconds since the stalest peer heartbeat changed",
+        )
+        self._m_peers_down = reg.counter(
+            "cluster_peers_down_total",
+            "peer hosts declared dead (heartbeat past the staleness budget)",
+        )
+        self._m_rounds = reg.counter(
+            "ckpt_consensus_rounds_total",
+            "save-cursor propose/ack consensus rounds completed",
+        )
+        # joined in close() under a bounded budget; report() lists them
+        # as stragglers (serve-engine thread-ledger convention) if they
+        # outlive it
+        # daemon (repo thread convention) and load-bearing here: a host
+        # dying of an UNHANDLED error must stop heartbeating — process
+        # death is exactly the signal peers detect — not keep the
+        # interpreter (and its beats) alive from a non-daemon thread
+        self._thread_ledger = [
+            threading.Thread(
+                target=self._heartbeat_loop, name="cluster-hb", daemon=True
+            ),
+            threading.Thread(
+                target=self._monitor_loop, name="cluster-mon", daemon=True
+            ),
+        ]
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        """Begin heartbeating and monitoring. Call AFTER the distributed
+        runtime has barriered the processes (its init is the startup-skew
+        bound the staleness budget must only cover from then on)."""
+        if self._started:
+            return self
+        os.makedirs(self._rounds_dir, exist_ok=True)
+        self._started_at = time.monotonic()
+        self._started = True
+        for t in self._thread_ledger:
+            t.start()
+        return self
+
+    def close(self):
+        """Stop the threads and join them under the bounded budget."""
+        self._closed_evt.set()
+        for t in self._thread_ledger:
+            if t.is_alive():
+                t.join(self._join_timeout)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def report(self):
+        """Telemetry/shutdown summary; ``straggler_threads`` is only
+        populated once closed (serve-engine report convention)."""
+        with self._lock:
+            dead = dict(self._dead)
+        stragglers = (
+            sorted(t.name for t in self._thread_ledger if t.is_alive())
+            if self._closed_evt.is_set()
+            else []
+        )
+        return {
+            "process_index": self._p,
+            "process_count": self._n,
+            "generation": self._gen,
+            "peers_down": dead,
+            "consensus_rounds": self._round,
+            "drain_at": self._drain_at,
+            "straggler_threads": stragglers,
+        }
+
+    # --- health supervision --------------------------------------------------
+
+    def _hb_path(self, p):
+        return os.path.join(self._dir, f"hb_{_proc_tag(p)}.json")
+
+    def _heartbeat_loop(self):
+        seq = 0
+        while True:
+            seq += 1
+            # the kill window: a host dying between beats is exactly what
+            # the peers' staleness monitor must detect
+            faultinject.fire("cluster.heartbeat")
+            try:
+                _write_json(
+                    self._hb_path(self._p),
+                    {"proc": self._p, "seq": seq, "host": socket.gethostname(),
+                     "pid": os.getpid(), "time": time.time()},
+                )
+            except OSError as e:
+                # a shared-filesystem hiccup is a MISSED BEAT (the peers'
+                # budget absorbs it), not a reason to kill this host
+                print(f"[cluster] heartbeat write failed: {e!r}", flush=True)
+            if self._closed_evt.wait(self._interval):
+                return
+
+    def _monitor_loop(self):
+        poll = max(min(self._interval / 2.0, self._staleness / 4.0), 0.02)
+        while not self._closed_evt.wait(poll):
+            now = time.monotonic()
+            worst = 0.0
+            for peer in self._peers:
+                blob = _read_json(self._hb_path(peer))
+                seq = blob.get("seq") if isinstance(blob, dict) else None
+                with self._lock:
+                    prev = self._last.get(peer)
+                    if seq is not None and (prev is None or seq != prev[0]):
+                        prev = (seq, now)
+                        self._last[peer] = prev
+                    since = prev[1] if prev is not None else self._started_at
+                    age = now - since
+                    worst = max(worst, age)
+                    if age > self._staleness and peer not in self._dead:
+                        self._dead[peer] = age if prev is not None else None
+                        self._m_peers_down.inc()
+                        print(
+                            f"[cluster] peer {peer} declared down: no "
+                            f"heartbeat for {age:.1f}s "
+                            f"(budget {self._staleness}s)",
+                            flush=True,
+                        )
+            self._m_hb_age.set(worst)
+
+    def check(self, what=None):
+        """Raise typed `PeerDown` if any peer is past the staleness budget
+        — the deadline check run at step boundaries, at collective entry
+        (`parallel.mesh.checked_collective`), and inside every cluster/
+        barrier wait, so a dead peer can never wedge a collective for the
+        full barrier timeout. Safe from any thread."""
+        with self._lock:
+            if not self._dead:
+                return
+            peer = sorted(self._dead)[0]
+            age = self._dead[peer]
+        raise PeerDown(peer, age, budget=self._staleness, where=what)
+
+    def peers_down(self):
+        with self._lock:
+            return dict(self._dead)
+
+    def _wait(self, predicate, what, timeout=None, stop_escape=False):
+        """`distributed._wait_for` with the health check folded into every
+        poll: a dead peer raises `PeerDown` promptly instead of burning
+        the whole timeout. Returns the predicate's first truthy value.
+
+        ``stop_escape``: return None as soon as the cluster stop flag is
+        up. Consensus rounds use it to resolve the drain-entry race — a
+        host that saw the flag first skipped this round entirely, so the
+        value being waited for will never arrive; abandoning (and
+        skipping the save) converges every host on "skip" instead of
+        burning the timeout against a peer that already moved on.
+        """
+        timeout = self._consensus_timeout if timeout is None else timeout
+        deadline = time.monotonic() + timeout
+        while True:
+            value = predicate()
+            if value:
+                return value
+            self.check(what)
+            if stop_escape and self.stop_requested():
+                return None
+            if time.monotonic() >= deadline:
+                raise ClusterError(
+                    f"cluster wait timed out after {timeout}s "
+                    f"waiting for {what}"
+                )
+            time.sleep(self._poll)
+
+    # --- coordinated preemption (stop flag + drain) --------------------------
+
+    @property
+    def _stop_request_path(self):
+        return os.path.join(self._dir, "stop_request.json")
+
+    def publish_stop(self, reason="signal"):
+        """Durably publish the cluster-wide stop flag (idempotent).
+
+        LOCK-FREE by design: `PreemptionGuard(cluster=...)` calls this
+        from inside a signal handler that may have interrupted a step
+        thread holding the supervisor lock — taking it here would
+        self-deadlock. The write is a bounded durable rename; a racing
+        double-publish is harmless (same flag, last rename wins).
+        """
+        self._stop_local.set()
+        if os.path.exists(self._stop_request_path):
+            return
+        # the kill window: a host dying before the flag lands has
+        # requested nothing — peers keep training
+        faultinject.fire("cluster.stopflag")
+        _write_json(
+            self._stop_request_path,
+            {"from": self._p, "reason": str(reason), "time": time.time()},
+        )
+        print(
+            f"[cluster] stop flag published by process {self._p} ({reason})",
+            flush=True,
+        )
+
+    def stop_requested(self):
+        """Whether any host published the stop flag. A set local event
+        short-circuits; otherwise one throttled ``os.path.exists`` per
+        ``stop_poll_s`` — the steady-state per-step cost is a monotonic
+        clock read. Lock-free (single step-thread consumer + the signal
+        handler's event set)."""
+        if self._stop_local.is_set():
+            return True
+        now = time.monotonic()
+        if now - self._stop_poll_last < self._stop_poll_s:
+            return False
+        self._stop_poll_last = now
+        if os.path.exists(self._stop_request_path):
+            self._stop_local.set()
+            return True
+        return False
+
+    def drain_step(self, boundary, interval=1):
+        """Advance the coordinated-drain state machine; step-thread only.
+
+        NON-BLOCKING by design. Call at EVERY step boundary once
+        `stop_requested()` is true, with the host's current step number
+        and the collective save interval (``save_every_steps``, >= 1).
+        The first call acks the flag with ``boundary``; the host then
+        KEEPS TRAINING — blocking here would deadlock against a peer
+        that has not seen the flag yet and walks into the next
+        collective save barrier expecting this host to join it. The
+        collective save schedule both keeps the cluster live while the
+        acks settle and bounds inter-host step skew to about one
+        ``interval``. Once all acks are visible, the leader publishes
+        ``stop_go`` with ``max(acks, own boundary) + interval + 2`` —
+        ahead of every host's possible position at publish time, so no
+        host has already trained past it. Returns the agreed drain step
+        once published (train until the boundary reaches it, then write
+        the final collective save there: every host commits the SAME
+        step), else None — keep training. Raises `PeerDown` if a peer
+        dies mid-protocol (the ack that never arrives).
+        """
+        if self._drain_at is not None:
+            return self._drain_at
+        go_path = os.path.join(self._dir, "stop_go.json")
+        if self._stop_acked_at is None:
+            self._stop_acked_at = int(boundary)
+            _write_json(
+                os.path.join(self._dir, f"stop_ack_{_proc_tag(self._p)}.json"),
+                {"proc": self._p, "boundary": int(boundary)},
+            )
+        if self._p == 0 and not os.path.exists(go_path):
+            acks = [
+                _read_json(
+                    os.path.join(self._dir, f"stop_ack_{_proc_tag(q)}.json")
+                )
+                for q in range(self._n)
+            ]
+            if all(a is not None for a in acks):
+                # margin: one `interval` for the skew the collective save
+                # schedule permits, +2 boundaries so the leader's notice
+                # of the last ack and the ackers' next go-poll both land
+                # before any host can reach the agreed step
+                agreed = max(
+                    [int(boundary)] + [int(a["boundary"]) for a in acks]
+                ) + max(int(interval), 1) + 2
+                _write_json(go_path, {"step": agreed})
+        self.check("coordinated drain")
+        go = _read_json(go_path)
+        if go is None:
+            return None
+        self._drain_at = int(go["step"])
+        print(
+            f"[cluster] coordinated drain: all hosts stop at step "
+            f"{self._drain_at}",
+            flush=True,
+        )
+        return self._drain_at
+
+    # --- save-cursor consensus (the coalesce arbiter) ------------------------
+
+    def agree_save_cursor(self, step, busy):
+        """One propose/ack round on an overlapped save cursor; returns
+        True to SAVE, False to SKIP — identical on every host. Step-thread
+        only; wired as ``AsyncCheckpointer(coalesce_arbiter=...)``.
+
+        Each host durably proposes whether its writer queue is busy; the
+        leader decides SKIP if ANY host is (the host that would otherwise
+        backpressure instead coalesces — on every host at once) and SAVE
+        only when all are free. Rounds are numbered by call order, which
+        the deterministic save schedule keeps identical across hosts.
+
+        A drain in progress (`stop_requested`) skips without a round:
+        flag visibility skews across hosts, so a peer may already have
+        skipped this round at entry and its proposal will never come —
+        every wait below escapes on the flag for the same reason, and
+        both paths converge on SKIP (consistent: the coordinated final
+        save at the drain step is the one that matters, and the flag
+        never clears, so round numbering can never diverge between two
+        LIVE rounds).
+        """
+        if self.stop_requested():
+            return False
+        r = self._round
+        self._round += 1
+        tag = f"r{r:06d}"
+        # the kill window peers must survive typed: a host dying before
+        # its proposal leaves the leader waiting -> PeerDown via _wait
+        faultinject.fire("cluster.propose")
+        _write_json(
+            os.path.join(self._rounds_dir, f"{tag}_{_proc_tag(self._p)}.json"),
+            {"round": r, "step": int(step), "busy": bool(busy)},
+        )
+        decision_path = os.path.join(self._rounds_dir, f"{tag}_decision.json")
+        if self._p == 0:
+            prop_paths = [
+                os.path.join(self._rounds_dir, f"{tag}_{_proc_tag(q)}.json")
+                for q in range(self._n)
+            ]
+
+            def _all_props():
+                props = [_read_json(pp) for pp in prop_paths]
+                return props if all(p is not None for p in props) else None
+
+            props = self._wait(
+                _all_props, f"consensus round {r} proposals",
+                stop_escape=True,
+            )
+            if props is None:  # drain started mid-round: abandon -> SKIP
+                return False
+            save = not any(bool(p["busy"]) for p in props)
+            # the leader-dies-before-deciding window: followers wait on
+            # the decision file -> PeerDown, drilled at cluster.ack
+            faultinject.fire("cluster.ack")
+            _write_json(
+                decision_path,
+                {"round": r, "step": int(step), "save": save},
+            )
+            self._prune_rounds(r)
+        decision = self._wait(
+            lambda: _read_json(decision_path),
+            f"consensus round {r} decision",
+            stop_escape=True,
+        )
+        if decision is None:  # drain started mid-round: abandon -> SKIP
+            return False
+        self._m_rounds.inc()
+        return bool(decision["save"])
+
+    def _prune_rounds(self, current, keep=8):
+        """Best-effort cleanup of rendezvous files from long-settled
+        rounds (leader only; every host has read them by ``keep`` rounds
+        later — consensus rounds are strictly ordered on each host)."""
+        cutoff = current - keep
+        if cutoff < 0:
+            return
+        try:
+            names = os.listdir(self._rounds_dir)
+        except OSError:
+            return
+        for name in names:
+            if not name.startswith("r") or len(name) < 7:
+                continue
+            try:
+                r = int(name[1:7])
+            except ValueError:
+                continue
+            if r < cutoff:
+                try:
+                    os.remove(os.path.join(self._rounds_dir, name))
+                except OSError:
+                    pass  # nclint: disable=swallowed-exception -- cleanup race: a peer's prune already removed it
+
+
+# --- elastic restart ---------------------------------------------------------
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+class ElasticSupervisor:
+    """Run training as a child process; on a typed `PeerDown` exit
+    (:data:`EXIT_PEER_DOWN`), re-form the cluster at the surviving
+    topology and relaunch — resuming from the latest valid save.
+
+    The child receives its topology in ``NCNET_ELASTIC_RUN`` /
+    ``NCNET_ELASTIC_GEN`` / ``NCNET_ELASTIC_PID`` /
+    ``NCNET_ELASTIC_NPROCS`` / ``NCNET_ELASTIC_COORD``;
+    ``build_argv(topology)`` maps that dict to the child command line
+    (``scripts/train.py --elastic`` appends the resume checkpoint for
+    generations > 0). Exit-code contract: 0 propagates (done),
+    ``EXIT_PEER_DOWN`` re-forms and relaunches (at most ``max_restarts``
+    times), anything else propagates unchanged — a kill stays a kill.
+
+    Re-formation: each surviving supervisor durably writes
+    ``reform_gen<G>_proc<P>`` (keyed by ORIGINAL process index — the
+    stable identity across generations) and waits ``reform_window_s``;
+    the survivors present after the window re-rank by original index,
+    rank 0 picks a free port and publishes ``coord_gen<G>.json`` from
+    its recorded hostname, and everyone relaunches. A survivor missing
+    the window is excluded (bounded-join semantics); a single survivor
+    relaunches as a plain single-process run (no coordinator).
+    """
+
+    def __init__(
+        self,
+        cluster_dir,
+        build_argv,
+        process_index,
+        process_count,
+        coordinator=None,
+        max_restarts=3,
+        reform_window_s=5.0,
+        poll_interval_s=0.05,
+    ):
+        self._base = os.path.abspath(cluster_dir)
+        self._build_argv = build_argv
+        self._orig_pid = int(process_index)  # stable across generations
+        self._pid = int(process_index)
+        self._n = int(process_count)
+        self._coord = coordinator
+        self._max_restarts = int(max_restarts)
+        self._window = float(reform_window_s)
+        self._poll = float(poll_interval_s)
+
+    def _topology(self, gen):
+        return {
+            "generation": gen,
+            "process_index": self._pid,
+            "process_count": self._n,
+            "coordinator": self._coord,
+        }
+
+    def run(self):
+        """Supervise until the training run completes or fails
+        non-elastically; returns the exit status to propagate."""
+        gen, restarts = 0, 0
+        while True:
+            topo = self._topology(gen)
+            env = dict(
+                os.environ,
+                NCNET_ELASTIC_RUN="1",
+                NCNET_ELASTIC_GEN=str(gen),
+                NCNET_ELASTIC_PID=str(self._pid),
+                NCNET_ELASTIC_NPROCS=str(self._n),
+                NCNET_ELASTIC_COORD=self._coord or "",
+            )
+            print(
+                f"[elastic] gen {gen}: launching process "
+                f"{self._pid}/{self._n}"
+                + (f" (coordinator {self._coord})" if self._coord else ""),
+                flush=True,
+            )
+            child = subprocess.Popen(self._build_argv(topo), env=env)
+            rc = child.wait()
+            if rc != EXIT_PEER_DOWN:
+                if rc != 0:
+                    print(f"[elastic] child exited {rc}: propagating "
+                          "(only a typed PeerDown restarts)", flush=True)
+                return rc
+            restarts += 1
+            if restarts > self._max_restarts:
+                print(
+                    f"[elastic] restart budget exhausted "
+                    f"({self._max_restarts}); giving up",
+                    flush=True,
+                )
+                return rc
+            gen += 1
+            self._reform(gen)
+
+    def _reform(self, gen):
+        _write_json(
+            os.path.join(
+                self._base, f"reform_gen{gen:03d}_{_proc_tag(self._orig_pid)}.json"
+            ),
+            {"orig": self._orig_pid, "host": socket.gethostname(),
+             "pid": os.getpid()},
+        )
+        time.sleep(self._window)
+        survivors = []
+        prefix = f"reform_gen{gen:03d}_proc"
+        for name in sorted(os.listdir(self._base)):
+            if name.startswith(prefix) and name.endswith(".json"):
+                blob = _read_json(os.path.join(self._base, name))
+                if blob is not None:
+                    survivors.append((int(blob["orig"]), blob))
+        survivors.sort()
+        ranks = [orig for orig, _ in survivors]
+        if self._orig_pid not in ranks:
+            # our own durable write should always be visible post-window;
+            # if not, the shared filesystem is gone — nothing to re-form
+            raise ClusterError(
+                f"re-formation gen {gen}: own reform file missing "
+                f"from {self._base}"
+            )
+        self._pid = ranks.index(self._orig_pid)
+        self._n = len(ranks)
+        coord_path = os.path.join(self._base, f"coord_gen{gen:03d}.json")
+        if self._n == 1:
+            self._coord = None
+        elif self._pid == 0:
+            host = dict(survivors)[self._orig_pid]["host"]
+            self._coord = f"{host}:{_free_port()}"
+            _write_json(coord_path, {"addr": self._coord})
+        else:
+            deadline = time.monotonic() + self._window * 4
+            while True:
+                blob = _read_json(coord_path)
+                if blob is not None:
+                    self._coord = blob["addr"]
+                    break
+                if time.monotonic() >= deadline:
+                    raise ClusterError(
+                        f"re-formation gen {gen}: no coordinator from "
+                        f"survivor rank 0 within {self._window * 4}s"
+                    )
+                time.sleep(self._poll)
+        print(
+            f"[elastic] re-formed gen {gen}: {self._n} survivor(s), "
+            f"this host is now process {self._pid}"
+            + (f", coordinator {self._coord}" if self._coord else ""),
+            flush=True,
+        )

@@ -174,11 +174,17 @@ def _npy_bytes(arr):
 # --- save (collective) -------------------------------------------------------
 
 
-def _wait_for(predicate, timeout, poll, what):
+def _wait_for(predicate, timeout, poll, what, health_check=None):
+    """Poll ``predicate`` until true. ``health_check`` (the cluster
+    supervisor's ``check``) runs every iteration so a dead peer raises a
+    typed ``PeerDown`` within its staleness budget instead of burning
+    the whole barrier timeout on a host that will never arrive."""
     deadline = time.monotonic() + timeout
     while True:
         if predicate():
             return
+        if health_check is not None:
+            health_check(what)
         if time.monotonic() >= deadline:
             raise ShardedSaveError(
                 f"distributed checkpoint barrier timed out after {timeout}s "
@@ -204,6 +210,7 @@ def save_sharded(
     process_count=None,
     barrier_timeout=600.0,
     poll_interval=0.05,
+    health_check=None,
 ):
     """Collectively write one ``step_<N>/`` save; EVERY process calls this
     with the same ``leaves`` structure (list of ``(key, value)`` in a
@@ -215,6 +222,11 @@ def save_sharded(
     and the chunks tile every leaf — the atomically-renamed commit
     manifest. Returns the committed step directory (all processes return
     only after the commit marker is durably visible).
+
+    ``health_check`` (e.g. ``ClusterSupervisor.check``) is called on
+    every barrier poll: a peer that died before writing its manifest (or
+    the commit marker) surfaces as a typed ``PeerDown`` within the
+    cluster's staleness budget instead of a ``barrier_timeout`` hang.
     """
     p, n = _proc_info(process_index, process_count)
     step_dir = os.path.join(os.path.abspath(base_dir), step_dir_name(step))
@@ -282,6 +294,7 @@ def save_sharded(
             lambda: _verified_file(commit_path),
             barrier_timeout, poll_interval,
             f"the commit manifest {commit_path}",
+            health_check=health_check,
         )
         return step_dir
 
@@ -290,6 +303,7 @@ def save_sharded(
         lambda: all(_verified_file(mp) for mp in man_paths),
         barrier_timeout, poll_interval,
         f"{n} per-host manifests in {step_dir}",
+        health_check=health_check,
     )
     manifests = []
     for mp in man_paths:

@@ -65,6 +65,23 @@ the failures the recovery paths claim to survive:
   ``ackpt.commit``              async writer thread, after the durable write
                                 returned — the save IS committed;
                                 ``latest_valid`` must land on it
+  ``cluster.heartbeat``         cluster supervision (`resilience.cluster`): on
+                                the heartbeat writer thread, before each beat —
+                                ``kill`` is a dying host whose peers must raise
+                                typed `PeerDown` within the staleness budget;
+                                ``delay:<s>`` models shared-filesystem stalls
+  ``cluster.stopflag``          before the durable stop flag publishes — a kill
+                                here loses the drain request (peers keep
+                                training; the signalled host's local exit path
+                                still applies)
+  ``cluster.propose``           save-cursor consensus, before this host's
+                                proposal write — a kill leaves the leader
+                                waiting on the round: peers must get typed
+                                `PeerDown`, not a barrier hang
+  ``cluster.ack``               save-cursor consensus, LEADER only, after all
+                                proposals arrived and before the decision
+                                write — a kill mid-decision leaves followers
+                                waiting: typed `PeerDown` on every survivor
   ============================  =================================================
 
 Actions: ``crash`` raises :class:`InjectedFault` (unwinds normally, finally

@@ -20,6 +20,15 @@ best-effort, then re-delivers. Hooks must be bounded and reentrant-safe
 (they run inside a signal handler, possibly interrupting the very flush
 they call into).
 
+Cluster mode: a preemption SIGTERM lands on ONE host of a multi-host
+run, but every host must drain to the same final save step or the
+resumed run diverges. With a `resilience.cluster.ClusterSupervisor`
+bound (``PreemptionGuard(cluster=...)`` or `bind_cluster`), the first
+signal ALSO publishes the cluster's durable stop flag — lock-free and
+best-effort (publishing must never turn a clean drain into a handler
+crash) — so the signal reaches every peer via the shared filesystem and
+the loop's `drain_step` round lands all hosts on one step.
+
 Only the main thread may install signal handlers; constructing the guard
 elsewhere (or where handlers are unavailable) degrades to a never-set
 flag rather than crashing — a loop guarded in a worker context simply
@@ -40,12 +49,20 @@ class PreemptionGuard:
     ...             checkpoint_and_return()
     """
 
-    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT),
+                 cluster=None):
         self.signals = tuple(signals)
         self._requested = threading.Event()
         self._previous = {}
         self._installed = False
         self._flush_hooks = []
+        self._cluster = cluster
+
+    def bind_cluster(self, cluster):
+        """Attach (or detach, with None) a cluster supervisor whose
+        durable stop flag the first signal publishes — a preemption on
+        this host then drains EVERY host (module docstring)."""
+        self._cluster = cluster
 
     @property
     def requested(self):
@@ -54,6 +71,22 @@ class PreemptionGuard:
     def request(self):
         """Programmatic preemption (tests, in-process orchestrators)."""
         self._requested.set()
+        self._publish_cluster_stop("programmatic request")
+
+    def _publish_cluster_stop(self, reason):
+        # best-effort and lock-free (cluster.publish_stop's contract):
+        # this runs inside the signal handler, and a shared-filesystem
+        # error must not turn a clean local drain into a handler crash —
+        # the loop's step-boundary publish retries via stop_requested()
+        if self._cluster is None:
+            return
+        try:
+            self._cluster.publish_stop(reason=reason)
+        except Exception as e:
+            print(
+                f"[resilience] cluster stop-flag publish failed: {e!r}",
+                flush=True,
+            )
 
     def add_flush_hook(self, hook):
         """Register a bounded callable drained before a second signal is
@@ -90,6 +123,7 @@ class PreemptionGuard:
             "next step boundary and exit cleanly (signal again to force)",
             flush=True,
         )
+        self._publish_cluster_stop(f"signal {signum}")
 
     def __enter__(self):
         try:

@@ -361,9 +361,17 @@ def load_latest_valid_sharded(dir_path, opt_state_target=None, shardings=None):
     """`load_latest_valid` over the sharded layout: newest committed
     ``step_<N>/`` whose every manifest entry verifies; walks back past
     uncommitted/torn directories AND committed saves with missing or
-    corrupt shards. Returns ``(CheckpointData, step_dir)``."""
-    from ncnet_tpu.resilience import distributed
+    corrupt shards. Returns ``(CheckpointData, step_dir)``.
 
+    Any live `AsyncCheckpointer` is flushed first: a restore overlapping
+    an in-flight async save (the elastic-restart path restores while the
+    previous generation's writer may still be draining) must see the
+    save either committed or absent — never mid-write — and must not
+    deadlock against it.
+    """
+    from ncnet_tpu.resilience import async_ckpt, distributed
+
+    async_ckpt.flush_live_checkpointers()
     return distributed.latest_valid_save(
         dir_path,
         lambda reader: _checkpoint_from_reader(
@@ -376,7 +384,12 @@ def load_latest_valid_any(path, opt_state_target=None, shardings=None):
     """Resume from whatever layout exists at ``path``: its sharded shadow
     directory when that holds a committed save (preferring the newer
     format), else the legacy single file — a run migrated mid-history
-    resumes from the right place either way."""
+    resumes from the right place either way. Flushes any live
+    `AsyncCheckpointer` first (see `load_latest_valid_sharded`) so a
+    restore never overlaps an in-flight async save."""
+    from ncnet_tpu.resilience import async_ckpt
+
+    async_ckpt.flush_live_checkpointers()
     sharded = path if os.path.isdir(path) else sharded_dir_for(path)
     if os.path.isdir(sharded):
         try:

@@ -32,6 +32,7 @@ from ncnet_tpu.ops.accounting import (
     peak_flops,
     train_step_flops_for_batch,
 )
+from ncnet_tpu.parallel import mesh as mesh_lib
 from ncnet_tpu.parallel.mesh import make_hybrid_mesh, replicate, shard_batch
 from ncnet_tpu.resilience import faultinject
 from ncnet_tpu.resilience.async_ckpt import AsyncCheckpointer, device_snapshot
@@ -174,6 +175,7 @@ def train(
     from_features=False,
     distributed_checkpoints=False,
     async_checkpoints=False,
+    cluster=None,
 ):
     """Run the training loop; returns ``(state, history)``.
 
@@ -198,6 +200,17 @@ def train(
     ``device_get`` funnel of the legacy path disappears. Metrics and plots
     stay process-0-only (they are tiny and host-side either way).
 
+    ``cluster`` (a started `resilience.cluster.ClusterSupervisor`) adds
+    multi-host coordination: its health check runs at every step
+    boundary, before every cross-process collective in `parallel.mesh`,
+    and inside the sharded-save barrier polls — a dead peer raises a
+    typed ``PeerDown`` within the staleness budget instead of hanging a
+    collective; a stop flag published by ANY host (its PreemptionGuard,
+    or ours) triggers a drain round that lands every host on the SAME
+    final committed save step; and in async multi-process sharded mode
+    the supervisor's save-cursor consensus re-enables coalescing (every
+    host skips or saves each overlapped snapshot together).
+
     ``async_checkpoints=True`` overlaps mid-epoch cursor saves with
     training (`resilience.async_ckpt`): the step thread hands the writer
     thread a donation-proof device snapshot (an O(leaves) copy DISPATCH,
@@ -210,6 +223,15 @@ def train(
     ``device_get`` funnel is off the step thread either way and sync and
     async runs produce byte-identical checkpoint files.
     """
+    # guard every cross-process collective (batch assembly, replication)
+    # with the cluster health check for the duration of the run: a dead
+    # peer raises a typed PeerDown at collective ENTRY instead of hanging
+    # the transfer (parallel.mesh.checked_collective)
+    prev_check = (
+        mesh_lib.set_collective_check(cluster.check)
+        if cluster is not None
+        else None
+    )
     try:
         return _train_impl(
             config, params, train_loader, val_loader, num_epochs,
@@ -219,8 +241,11 @@ def train(
             initial_train_hist, initial_val_hist, log_every, profile_dir,
             profile_steps, save_every_steps, keep_checkpoints, preemption,
             from_features, distributed_checkpoints, async_checkpoints,
+            cluster,
         )
     finally:
+        if cluster is not None:
+            mesh_lib.set_collective_check(prev_check)
         _close_quietly(train_loader, val_loader)
 
 
@@ -231,7 +256,7 @@ def _train_impl(
     opt_state, initial_best_val, initial_train_hist, initial_val_hist,
     log_every, profile_dir, profile_steps, save_every_steps,
     keep_checkpoints, preemption, from_features, distributed_checkpoints,
-    async_checkpoints,
+    async_checkpoints, cluster,
 ):
     if from_features:
         from ncnet_tpu.train.step import check_from_features_frozen
@@ -287,11 +312,18 @@ def _train_impl(
     # just block for it), so the step thread never executes the gather
     # itself. Multi-process sharded saves are collective — a snapshot
     # coalesced on one host but written on another would wedge the
-    # commit barrier — so coalescing degrades to deterministic
-    # backpressure there (every process writes the same save sequence).
+    # commit barrier — so without a cluster supervisor coalescing
+    # degrades to deterministic backpressure there (every process writes
+    # the same save sequence). WITH a supervisor, skipping becomes the
+    # collective decision it has to be: the save-cursor consensus round
+    # (cluster.agree_save_cursor) makes every host coalesce or save each
+    # overlapped snapshot together, re-enabling coalescing multi-process.
+    multi_sharded = distributed_checkpoints and jax.process_count() > 1
+    consensus = cluster is not None and multi_sharded
     ackpt = AsyncCheckpointer(
         async_mode=async_checkpoints,
-        coalesce=not (distributed_checkpoints and jax.process_count() > 1),
+        coalesce=consensus or not multi_sharded,
+        coalesce_arbiter=cluster.agree_save_cursor if consensus else None,
     )
     # a second SIGTERM during an in-flight final save gets a bounded
     # grace to commit before the guard re-delivers (signals.py)
@@ -352,8 +384,12 @@ def _train_impl(
             sdir = sharded_dir_for(os.path.join(checkpoint_dir, checkpoint_name))
 
             def write(d):
+                # the barrier polls run the cluster health check so a
+                # peer that dies mid-save raises typed PeerDown instead
+                # of burning the full barrier timeout
                 save_checkpoint_sharded(
-                    sdir, d, is_best=is_best, keep=keep_checkpoints
+                    sdir, d, is_best=is_best, keep=keep_checkpoints,
+                    health_check=cluster.check if cluster is not None else None,
                 )
 
             prepare = None
@@ -472,6 +508,32 @@ def _train_impl(
                         flush=True,
                     )
                 want_preempt = preemption is not None and preemption.requested
+                if cluster is not None:
+                    # a dead peer surfaces HERE as a typed PeerDown, not
+                    # as a hang inside the next collective or barrier
+                    cluster.check("step boundary")
+                    if want_preempt:
+                        # the guard's in-handler publish is best-effort;
+                        # republishing at the boundary is idempotent and
+                        # guarantees the flag reaches the peers
+                        cluster.publish_stop(reason="preemption signal")
+                    if cluster.stop_requested():
+                        # non-blocking drain state machine: ack once,
+                        # keep training (and keep joining the collective
+                        # save schedule — that's what bounds host skew
+                        # and keeps the cluster deadlock-free while the
+                        # acks settle), stop at the agreed step once the
+                        # leader publishes it
+                        drain_at = cluster.drain_step(
+                            int(state.step),
+                            interval=max(int(save_every_steps or 0), 1),
+                        )
+                        want_preempt = (
+                            drain_at is not None
+                            and int(state.step) >= drain_at
+                        )
+                    else:
+                        want_preempt = False
                 if (
                     save_every_steps and (i + 1) % save_every_steps == 0
                 ) or want_preempt:

@@ -19,6 +19,7 @@ import jax
 from ncnet_tpu.data.loader import DataLoader
 from ncnet_tpu.data.pairs import ImagePairDataset, SyntheticPairDataset
 from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+from ncnet_tpu.resilience.cluster import EXIT_PEER_DOWN, PeerDown
 from ncnet_tpu.resilience.signals import PreemptionGuard
 from ncnet_tpu.train.checkpoint import load_latest_valid_any, sharded_dir_for
 from ncnet_tpu.train.loop import train
@@ -39,6 +40,39 @@ def _conv4d_impl_arg(value):
                 "kernel-grad lowerings)"
             )
     return value
+
+
+def _run_elastic_supervisor(args):
+    """The ``--elastic`` parent: supervise the training process and, when
+    it exits with the typed `PeerDown` status, re-form the cluster at the
+    surviving topology and relaunch resuming from the latest valid save
+    (resilience.cluster.ElasticSupervisor). Initial topology comes from
+    ``NCNET_ELASTIC_PID`` / ``NCNET_ELASTIC_NPROCS`` /
+    ``NCNET_ELASTIC_COORD`` (single-process by default); the worker child
+    is this same script with ``NCNET_ELASTIC_RUN=1``."""
+    from ncnet_tpu.resilience.cluster import ElasticSupervisor
+
+    cluster_dir = args.cluster_dir or os.path.join(
+        args.result_model_dir, "cluster"
+    )
+    os.makedirs(cluster_dir, exist_ok=True)
+    pid = int(os.environ.get("NCNET_ELASTIC_PID", "0"))
+    nprocs = int(os.environ.get("NCNET_ELASTIC_NPROCS", "1"))
+    coord = os.environ.get("NCNET_ELASTIC_COORD", "") or None
+    base_argv = [a for a in sys.argv[1:] if a != "--elastic"]
+    ckpt_path = os.path.join(args.result_model_dir, args.result_model_fn)
+
+    def build_argv(topo):
+        argv = [sys.executable, os.path.abspath(__file__)] + list(base_argv)
+        if topo["generation"] > 0 and "--checkpoint" not in base_argv:
+            # generation > 0 IS a resume: the previous generation left a
+            # committed save the surviving topology restores from
+            argv += ["--checkpoint", ckpt_path]
+        return argv
+
+    return ElasticSupervisor(
+        cluster_dir, build_argv, pid, nprocs, coordinator=coord
+    ).run()
 
 
 def main():
@@ -163,6 +197,38 @@ def main():
     p.add_argument("--multihost", action="store_true",
                    help="join a multi-host JAX runtime (TPU pod slices: "
                         "auto-detected); shards the data loaders per host")
+    p.add_argument("--cluster", action="store_true",
+                   help="multi-host cluster supervision "
+                        "(resilience.cluster): per-host heartbeats over "
+                        "the shared checkpoint filesystem, typed PeerDown "
+                        "instead of hung collectives when a peer dies, a "
+                        "durable stop flag so a SIGTERM on ANY host drains "
+                        "ALL hosts to the same final save step, and save-"
+                        "cursor consensus re-enabling async coalescing "
+                        "multi-process. No-op single-host")
+    p.add_argument("--cluster-dir", type=str, default="", dest="cluster_dir",
+                   help="shared directory for cluster rendezvous files "
+                        "(default <result_model_dir>/cluster); must be on "
+                        "the same shared filesystem as the checkpoints")
+    p.add_argument("--cluster-heartbeat-s", type=float, default=2.0,
+                   dest="cluster_heartbeat_s",
+                   help="heartbeat write interval in seconds")
+    p.add_argument("--cluster-staleness-s", type=float, default=15.0,
+                   dest="cluster_staleness_s",
+                   help="seconds without a peer heartbeat change before it "
+                        "is declared dead (typed PeerDown)")
+    p.add_argument("--elastic", action="store_true",
+                   help="supervise the run for elastic restart: training "
+                        "runs as a child process (implies --cluster "
+                        "semantics multi-host); when a peer dies the child "
+                        "exits with the typed PeerDown status, the "
+                        "survivors re-form at the surviving topology, and "
+                        "training resumes from the latest valid save. "
+                        "Initial topology via NCNET_ELASTIC_PID/NPROCS/"
+                        "COORD (single-process by default)")
+    p.add_argument("--synthetic_pairs", type=int, default=256,
+                   help="with --synthetic: number of generated training "
+                        "pairs (validation uses a fixed 32)")
     p.add_argument("--distributed-checkpoints", action="store_true",
                    dest="distributed_checkpoints",
                    help="per-host sharded checkpoint layout "
@@ -260,6 +326,13 @@ def main():
                         "unless --chunk_remat/--no-chunk_remat is given")
     args = p.parse_args()
 
+    elastic_child = os.environ.get("NCNET_ELASTIC_RUN") == "1"
+    if args.elastic and not elastic_child:
+        # the supervising parent never touches the XLA backend: it only
+        # spawns/reaps the training child and runs the re-formation
+        # rendezvous between generations
+        sys.exit(_run_elastic_supervisor(args))
+
     from ncnet_tpu.telemetry.profiler import parse_steps
 
     try:
@@ -305,12 +378,29 @@ def main():
         return "tlc//btl,btl4,tlc/tlc/tf3" if n_layers == 3 else "tlc"
 
     host_id, n_hosts = 0, 1
-    if args.multihost:
+    if elastic_child:
+        # topology is dictated by the elastic supervisor (it shrinks at
+        # each re-formation); a single survivor runs without any
+        # distributed runtime at all
+        n = int(os.environ.get("NCNET_ELASTIC_NPROCS", "1"))
+        if n > 1:
+            from ncnet_tpu.parallel.mesh import initialize_multihost
+
+            host_id, n_hosts = initialize_multihost(
+                coordinator_address=os.environ["NCNET_ELASTIC_COORD"],
+                num_processes=n,
+                process_id=int(os.environ.get("NCNET_ELASTIC_PID", "0")),
+            )
+            print(f"elastic gen {os.environ.get('NCNET_ELASTIC_GEN', '0')}: "
+                  f"process {host_id}/{n_hosts}, "
+                  f"{jax.device_count()} global devices")
+    elif args.multihost:
         from ncnet_tpu.parallel.mesh import initialize_multihost
 
         host_id, n_hosts = initialize_multihost()
         print(f"multihost: process {host_id}/{n_hosts}, "
               f"{jax.device_count()} global devices")
+    if n_hosts > 1:
         n_dev = jax.device_count()
         if args.batch_size % n_dev:
             p.error(
@@ -318,6 +408,26 @@ def main():
                 f"divisible by the {n_dev} global devices (the data-"
                 f"parallel shard axis), hence also the {n_hosts} hosts"
             )
+
+    cluster = None
+    if (args.cluster or elastic_child) and n_hosts > 1:
+        # started AFTER jax.distributed.initialize barriered the
+        # processes, so the staleness budget never has to absorb launch
+        # skew (resilience.cluster docstring)
+        from ncnet_tpu.resilience.cluster import ClusterSupervisor
+
+        cluster_dir = args.cluster_dir or os.path.join(
+            args.result_model_dir, "cluster"
+        )
+        cluster = ClusterSupervisor(
+            cluster_dir, host_id, n_hosts,
+            generation=int(os.environ.get("NCNET_ELASTIC_GEN", "0")),
+            heartbeat_interval_s=args.cluster_heartbeat_s,
+            staleness_s=args.cluster_staleness_s,
+        ).start()
+        print(f"cluster supervision ON: {cluster_dir} "
+              f"(heartbeat {args.cluster_heartbeat_s}s, "
+              f"staleness {args.cluster_staleness_s}s)", flush=True)
 
     if (
         not args.fe_weights
@@ -533,7 +643,9 @@ def main():
 
     size = (args.image_size, args.image_size)
     if args.synthetic:
-        train_ds = SyntheticPairDataset(n=256, output_size=size, seed=args.seed)
+        train_ds = SyntheticPairDataset(
+            n=args.synthetic_pairs, output_size=size, seed=args.seed
+        )
         val_ds = SyntheticPairDataset(n=32, output_size=size, seed=args.seed + 1)
     else:
         train_ds = ImagePairDataset(
@@ -609,8 +721,9 @@ def main():
     # preemption notice) or Ctrl-C checkpoints once at the next step
     # boundary and exits cleanly, with the worker pools shut down on every
     # path (train() also closes the loaders from its own finally)
+    peer_down = False
     try:
-        with PreemptionGuard() as guard, make_loader(
+        with PreemptionGuard(cluster=cluster) as guard, make_loader(
             "train", True
         ) as train_loader, make_loader("val", False) as val_loader:
             _, history = train(
@@ -640,14 +753,37 @@ def main():
                 from_features=from_features,
                 distributed_checkpoints=args.distributed_checkpoints,
                 async_checkpoints=args.async_checkpoints,
+                cluster=cluster,
             )
+    except PeerDown as e:
+        # the typed elastic-restart path: the supervisor parent re-forms
+        # the cluster at the surviving topology and relaunches resuming
+        # from the latest valid save; without --elastic the status still
+        # tells the operator's process manager this is a retryable
+        # topology failure, not a crash
+        print(f"[cluster] {e}; exiting {EXIT_PEER_DOWN} "
+              "(elastic restart status)", flush=True)
+        peer_down = True
+        history = {}
     finally:
+        if cluster is not None:
+            cluster.close()
+            print(f"[cluster] report: {cluster.report()}", flush=True)
         # flushes the event log + .prom snapshot on EVERY exit path, the
         # same posture as the loaders' context managers (no-op without
         # --telemetry)
         from ncnet_tpu import telemetry
 
         telemetry.stop()
+    if peer_down:
+        # HARD exit, after the cleanup above: a host departing on
+        # PeerDown must not join the jax distributed runtime's atexit
+        # shutdown barrier — with the peer dead, the coordination
+        # service aborts the process (SIGABRT), clobbering the typed
+        # status the elastic supervisor keys restarts on
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(EXIT_PEER_DOWN)
     if history.get("preempted"):
         print("exiting after preemption checkpoint (resume with "
               f"--checkpoint {os.path.join(args.result_model_dir, args.result_model_fn)})",

@@ -1,0 +1,1064 @@
+"""Cluster supervision proofs (resilience.cluster + the wired train loop).
+
+Layers, cheapest first:
+
+  * protocol units — threaded supervisor pairs over one tmp dir pin the
+    heartbeat/PeerDown budget, the non-blocking drain agreement, and the
+    save-cursor consensus (save/skip + the stop-flag escape), plus the
+    typed-crash and lock-audit posture (`ScheduleFuzzer`,
+    ``find_cycles() == []``, ``straggler_threads == []``);
+  * arbiter units — a fake arbiter pins the `AsyncCheckpointer`
+    collective-skip semantics (skip drops the snapshot on the spot,
+    save enqueues; blocking submits never consult the arbiter);
+  * restore/flush regression — `load_latest_valid_any` overlapping an
+    in-flight async save must flush the live writer first (PR-19
+    follow-up (a)): it reads the COMMITTED newer save, no torn refs, no
+    deadlock;
+  * subprocess drills (`conftest.spawn_cpu_cluster`, the
+    tests/test_multihost.py child-main technique) — the acceptance
+    drills: kill one host mid-epoch and the survivor raises typed
+    `PeerDown` within the staleness budget, then the elastic supervisor
+    re-forms at the surviving topology and the resumed run matches the
+    uninterrupted fixture BITWISE; a stop-flag drain lands both hosts on
+    the identical committed step with consensus coalescing engaged
+    (``ckpt_coalesced_total > 0`` on every host); consensus-round kills
+    at ``cluster.propose`` / ``cluster.ack`` leave the survivor with a
+    typed `PeerDown`, wall-bounded (these two run WITHOUT jax — the
+    rendezvous protocol is pure-filesystem, so the drill doesn't pay a
+    compile); and the satellite case: a SIGTERM on one host of a
+    NON-cluster multi-process run still exits that host cleanly with a
+    committed, walk-back-valid save (the peer's next barrier fails
+    typed `ShardedSaveError` — the documented degradation cluster mode
+    removes).
+"""
+
+import json
+import os
+import re
+import signal
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # child interpreters start with sys.path[0]=tests/
+    sys.path.insert(0, REPO)
+
+from ncnet_tpu.analysis import concurrency
+from ncnet_tpu.resilience import faultinject
+from ncnet_tpu.resilience.cluster import (
+    EXIT_PEER_DOWN,
+    ClusterError,
+    ClusterSupervisor,
+    ElasticSupervisor,
+    PeerDown,
+)
+
+if __name__ != "__main__":  # children must not import pytest plugins
+    import numpy as np
+
+    import jax
+
+    from conftest import multiprocess_cpu_supported, spawn_cpu_cluster
+    from ncnet_tpu.models.immatchnet import ImMatchNetConfig
+    from ncnet_tpu.resilience import distributed
+    from ncnet_tpu.resilience.async_ckpt import (
+        AsyncCheckpointer,
+        flush_live_checkpointers,
+    )
+    from ncnet_tpu.telemetry.registry import MetricsRegistry
+    from ncnet_tpu.train.checkpoint import (
+        CheckpointData,
+        load_latest_valid_any,
+        save_checkpoint_sharded,
+        sharded_dir_for,
+    )
+
+    CFG = ImMatchNetConfig(ncons_kernel_sizes=(3,), ncons_channels=(1,))
+
+    # Capability gate for the subprocess drills only — the protocol
+    # units above them are single-process and always run.
+    needs_mp = pytest.mark.skipif(
+        not multiprocess_cpu_supported(),
+        reason="this jaxlib lacks multiprocess CPU collectives "
+        "(no gloo implementation to back jax.distributed on CPU)",
+    )
+else:
+    # child mode: tests are never collected, but their decorators still
+    # evaluate at import — resolve to the identity
+    def needs_mp(f):
+        return f
+
+WAIT = 30.0  # generous Event/join budget: a hang fails the test, not CI
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_state():
+    faultinject.clear()
+    yield
+    faultinject.clear()
+    concurrency.clear()
+
+
+def _pair(tmp_path, **kw):
+    """Two supervisors over one shared dir — the in-process stand-in for
+    two hosts (each gets its own heartbeat/monitor threads; the shared
+    filesystem is the real medium either way)."""
+    kw.setdefault("heartbeat_interval_s", 0.05)
+    kw.setdefault("staleness_s", 1.0)
+    kw.setdefault("poll_interval_s", 0.01)
+    kw.setdefault("stop_poll_s", 0.01)
+    regs = [kw.pop("registry", None) or MetricsRegistry() for _ in range(2)]
+    sups = [
+        ClusterSupervisor(str(tmp_path), p, 2, registry=regs[p], **kw)
+        for p in range(2)
+    ]
+    for s in sups:
+        s.start()
+    return sups, regs
+
+
+# --- health supervision ------------------------------------------------------
+
+
+def test_peer_down_typed_within_budget(tmp_path):
+    """Kill one 'host' (stop its heartbeats); the survivor must raise a
+    TYPED PeerDown within the staleness budget + monitor slack — never
+    hang, never a bare timeout."""
+    (s0, s1), (reg0, _) = _pair(tmp_path)
+    time.sleep(0.4)  # both sides see a first beat
+    s0.check("warmup")  # alive cluster: no raise
+
+    s1.close()  # the peer dies (heartbeats stop; files remain = stale)
+    t0 = time.monotonic()
+    err = None
+    while time.monotonic() - t0 < 10.0:
+        try:
+            s0.check("drill")
+        except PeerDown as e:
+            err = e
+            break
+        time.sleep(0.02)
+    assert err is not None, "peer never declared down"
+    assert err.host == 1
+    assert err.last_seen is not None and err.last_seen >= 1.0
+    assert err.budget == 1.0
+    assert "peer 1 down" in str(err) and "drill" in str(err)
+    # detection latency bounded: budget (1.0s) + monitor poll slack
+    assert time.monotonic() - t0 < 3.0
+    assert list(s0.peers_down()) == [1]
+    assert reg0.get("cluster_peers_down_total").value == 1
+    assert reg0.get("cluster_heartbeat_age_s").value >= 1.0
+
+    s0.close()
+    assert s0.report()["straggler_threads"] == []
+    assert s1.report()["straggler_threads"] == []
+
+
+def test_peer_down_is_a_cluster_error(tmp_path):
+    assert issubclass(PeerDown, ClusterError)
+    assert EXIT_PEER_DOWN == 75  # EX_TEMPFAIL: the elastic restart code
+
+
+# --- coordinated preemption (stop flag + non-blocking drain) -----------------
+
+
+def test_stop_flag_reaches_peer_and_drain_agrees(tmp_path):
+    """publish_stop on one host is visible to the other via the durable
+    flag; the non-blocking drain lands both on ONE agreed step ahead of
+    both ack boundaries."""
+    (s0, s1), _ = _pair(tmp_path)
+    assert not s1.stop_requested()
+    s0.publish_stop("test signal")
+    assert s0.stop_requested()
+
+    res = {}
+
+    def drive(sup, boundary):
+        # the loop's shape: advance a boundary at a time, polling the
+        # flag and the drain state machine — never blocking
+        while True:
+            if sup.stop_requested():
+                at = sup.drain_step(boundary, interval=2)
+                if at is not None and boundary >= at:
+                    res[sup._p] = (boundary, at)
+                    return
+            boundary += 1
+            time.sleep(0.02)
+
+    t0 = threading.Thread(target=drive, args=(s0, 5))
+    t1 = threading.Thread(target=drive, args=(s1, 7))
+    t0.start()
+    t1.start()
+    t0.join(WAIT)
+    t1.join(WAIT)
+    assert res[0][1] == res[1][1], res  # ONE agreed drain step
+    # the agreed step is AHEAD of both acks (margin: interval + 2)
+    assert res[0][1] >= 7 + 2
+    assert res[0][0] == res[0][1] and res[1][0] == res[1][1]
+    s0.close()
+    s1.close()
+    assert s0.report()["drain_at"] == res[0][1]
+
+
+def test_drain_step_nonblocking_before_acks(tmp_path):
+    """A host whose peer has not acked yet gets None (keep training) —
+    the deadlock-freedom property: no cluster wait ever blocks the step
+    thread while a peer may be inside a collective."""
+    (s0, s1), _ = _pair(tmp_path)
+    s0.publish_stop("one-sided")
+    t0 = time.monotonic()
+    assert s0.drain_step(3, interval=1) is None  # returns immediately
+    assert time.monotonic() - t0 < 0.5
+    # peer acks -> leader publishes -> both resolve
+    assert s1.stop_requested()
+    while s1.drain_step(4, interval=1) is None:
+        assert s0.drain_step(3, interval=1) is not None or True
+        time.sleep(0.02)
+        assert time.monotonic() - t0 < WAIT
+    assert s0.drain_step(3, interval=1) == s1.drain_step(4, interval=1)
+    s0.close()
+    s1.close()
+
+
+# --- save-cursor consensus ---------------------------------------------------
+
+
+def test_consensus_save_and_skip_rounds(tmp_path):
+    """All-free -> SAVE on every host; any-busy -> SKIP on every host;
+    the per-host round counter metric ticks once per completed round."""
+    (s0, s1), (reg0, reg1) = _pair(tmp_path)
+    out = {}
+
+    def round_pair(step, busy0, busy1):
+        t = threading.Thread(
+            target=lambda: out.__setitem__("b", s1.agree_save_cursor(step, busy1))
+        )
+        t.start()
+        out["a"] = s0.agree_save_cursor(step, busy0)
+        t.join(WAIT)
+        return out["a"], out["b"]
+
+    assert round_pair(2, False, False) == (True, True)
+    assert round_pair(4, False, True) == (False, False)
+    assert round_pair(6, True, False) == (False, False)
+    assert reg0.get("ckpt_consensus_rounds_total").value == 3
+    assert reg1.get("ckpt_consensus_rounds_total").value == 3
+    s0.close()
+    s1.close()
+    assert s0.report()["consensus_rounds"] == 3
+
+
+def test_consensus_skips_without_round_once_stop_flag_up(tmp_path):
+    """The drain-entry race resolution: with the stop flag up, rounds
+    skip at entry (and a host already inside a round escapes on the
+    flag) — both paths converge on SKIP, so save sets stay identical."""
+    (s0, s1), (reg0, _) = _pair(tmp_path)
+    # a follower enters its round BEFORE seeing the flag; the leader
+    # (flag already local) never joins round 0 -> the follower's wait
+    # must escape on the flag, not burn the consensus timeout
+    out = {}
+    follower = threading.Thread(
+        target=lambda: out.__setitem__("b", s1.agree_save_cursor(3, False))
+    )
+    s0.publish_stop("drain race")
+    out["a"] = s0.agree_save_cursor(3, False)  # entry skip, no round
+    follower.start()
+    follower.join(WAIT)
+    assert out == {"a": False, "b": False}
+    assert reg0.get("ckpt_consensus_rounds_total").value == 0
+    s0.close()
+    s1.close()
+
+
+def test_consensus_propose_crash_is_typed(tmp_path):
+    """A crash armed at ``cluster.propose`` unwinds typed (InjectedFault)
+    — the kill variant of this window is drilled in the subprocess
+    tests below."""
+    faultinject.inject("cluster.propose", "crash")
+    s = ClusterSupervisor(
+        str(tmp_path), 0, 1, heartbeat_interval_s=0.05, staleness_s=5.0
+    )
+    s.start()
+    try:
+        with pytest.raises(faultinject.InjectedFault):
+            s.agree_save_cursor(1, False)
+    finally:
+        s.close()
+    assert s.report()["straggler_threads"] == []
+
+
+# --- concurrency audit -------------------------------------------------------
+
+
+def test_cluster_lock_audit_fuzzed(tmp_path):
+    """The full protocol surface under the runtime lock audit with a
+    fuzzed schedule: no lock-order cycles, no straggler threads."""
+    concurrency.clear()
+    concurrency.enable()
+    with concurrency.ScheduleFuzzer(seed=7, p=0.5, max_sleep_s=5e-5):
+        (s0, s1), _ = _pair(tmp_path, heartbeat_interval_s=0.02)
+        out = {}
+        t = threading.Thread(
+            target=lambda: out.__setitem__("b", s1.agree_save_cursor(1, False))
+        )
+        t.start()
+        out["a"] = s0.agree_save_cursor(1, False)
+        t.join(WAIT)
+        assert out == {"a": True, "b": True}
+        s0.check("fuzzed boundary")
+        s0.publish_stop("fuzz drain")
+        res = {}
+
+        def drive(sup, b):
+            while True:
+                if sup.stop_requested():
+                    at = sup.drain_step(b, interval=1)
+                    if at is not None and b >= at:
+                        res[sup._p] = at
+                        return
+                b += 1
+                time.sleep(0.005)
+
+        ths = [
+            threading.Thread(target=drive, args=(s0, 2)),
+            threading.Thread(target=drive, args=(s1, 3)),
+        ]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(WAIT)
+        assert res[0] == res[1]
+        s0.close()
+        s1.close()
+    assert concurrency.find_cycles() == [], concurrency.report()["edges"]
+    assert s0.report()["straggler_threads"] == []
+    assert s1.report()["straggler_threads"] == []
+    concurrency.clear()
+
+
+# --- elastic supervisor units ------------------------------------------------
+
+
+def test_elastic_propagates_non_peerdown_exits(tmp_path):
+    """Only EXIT_PEER_DOWN restarts; a plain failure (or success)
+    propagates unchanged — a kill stays a kill."""
+    sup = ElasticSupervisor(
+        str(tmp_path),
+        lambda topo: [sys.executable, "-c", "raise SystemExit(3)"],
+        0,
+        1,
+        reform_window_s=0.1,
+    )
+    assert sup.run() == 3
+
+    sup_ok = ElasticSupervisor(
+        str(tmp_path),
+        lambda topo: [sys.executable, "-c", "pass"],
+        0,
+        1,
+        reform_window_s=0.1,
+    )
+    assert sup_ok.run() == 0
+
+
+def test_elastic_restart_budget_exhausts(tmp_path):
+    """A child that always dies PeerDown re-forms at most max_restarts
+    times, then the typed status propagates."""
+    launches = []
+
+    def argv(topo):
+        launches.append(dict(topo))
+        return [sys.executable, "-c", f"raise SystemExit({EXIT_PEER_DOWN})"]
+
+    sup = ElasticSupervisor(
+        str(tmp_path), argv, 0, 1, max_restarts=2, reform_window_s=0.05
+    )
+    assert sup.run() == EXIT_PEER_DOWN
+    assert len(launches) == 3  # initial + 2 restarts
+    assert [t["generation"] for t in launches] == [0, 1, 2]
+
+
+# --- collective health hook + barrier health check ---------------------------
+
+
+def test_collective_check_hook_roundtrip():
+    from ncnet_tpu.parallel import mesh
+
+    calls = []
+    prev = mesh.set_collective_check(calls.append)
+    try:
+        mesh.checked_collective("drill collective")
+        assert calls == ["drill collective"]
+    finally:
+        mesh.set_collective_check(prev)
+    # uninstalled: a no-op again
+    mesh.checked_collective("after uninstall")
+    assert calls == ["drill collective"]
+
+
+def test_sharded_barrier_health_check_beats_timeout():
+    """A dead peer raises typed PeerDown from inside the save barrier
+    poll loop — not a 30s ShardedSaveError burn."""
+
+    def hc(what):
+        raise PeerDown(1, 2.5, budget=1.0, where=what)
+
+    t0 = time.monotonic()
+    with pytest.raises(PeerDown):
+        distributed._wait_for(
+            lambda: False, timeout=30.0, poll=0.01,
+            what="manifests", health_check=hc,
+        )
+    assert time.monotonic() - t0 < 1.0
+
+
+# --- AsyncCheckpointer coalesce arbiter --------------------------------------
+
+
+class _GatedWriter:
+    """Deterministic writer stand-in (test_async_ckpt idiom): records
+    payloads, blocks until released."""
+
+    def __init__(self, gated=True):
+        self.gated = gated
+        self.gate = threading.Event()
+        self.entered = threading.Event()
+        self.written = []
+
+    def __call__(self, data):
+        self.entered.set()
+        if self.gated and not self.gate.wait(WAIT):
+            raise RuntimeError("writer gate never released")
+        self.written.append(data)
+
+
+def test_arbiter_skip_drops_snapshot_everywhere():
+    """Arbiter says SKIP: the snapshot is dropped on the spot — counted
+    as coalesced, ticket superseded, writer never sees it."""
+    calls = []
+    ack = AsyncCheckpointer(
+        async_mode=True,
+        registry=MetricsRegistry(),
+        coalesce_arbiter=lambda step, busy: calls.append((step, busy)) or False,
+    )
+    w = _GatedWriter(gated=False)
+    t = ack.submit(1, w, step=1)
+    assert calls == [(1, False)]
+    assert t.superseded and t.done.is_set()
+    assert not w.entered.is_set() and w.written == []
+    rep = ack.report()
+    assert rep["consensus"] is True
+    assert rep["consensus_skips_total"] == 1
+    ack.close()
+
+
+def test_arbiter_save_enqueues_and_busy_is_reported():
+    """Arbiter says SAVE: plain enqueue. With the writer wedged and a
+    save queued, the next overlapped submit reports busy=True to the
+    round — the signal the leader turns into a collective SKIP."""
+    calls = []
+
+    def arbiter(step, busy):
+        calls.append((step, busy))
+        return step != 3  # round 3: the cluster decides SKIP
+
+    ack = AsyncCheckpointer(
+        async_mode=True, registry=MetricsRegistry(), coalesce_arbiter=arbiter
+    )
+    w = _GatedWriter()
+    ack.submit(1, w, step=1)
+    assert w.entered.wait(WAIT)  # in flight, gate held
+    ack.submit(2, w, step=2)  # queued behind it
+    t3 = ack.submit(3, w, step=3)  # queue busy -> arbiter skips
+    assert calls == [(1, False), (2, False), (3, True)]
+    assert t3.superseded
+    w.gate.set()
+    assert ack.flush(timeout=WAIT)
+    ack.close()
+    assert w.written == [1, 2]  # the skipped newer snapshot never wrote
+    assert ack.report()["consensus_skips_total"] == 1
+
+
+def test_arbiter_bypassed_for_blocking_submits():
+    """wait=True (and sync mode) submits are part of the deterministic
+    schedule on every host — they must never consult the arbiter."""
+    calls = []
+    ack = AsyncCheckpointer(
+        async_mode=True,
+        registry=MetricsRegistry(),
+        coalesce_arbiter=lambda *a: calls.append(a) or True,
+    )
+    w = _GatedWriter(gated=False)
+    ack.submit(1, w, step=1, wait=True)
+    ack.close()
+    assert calls == [] and w.written == [1]
+
+
+# --- restore overlapping an in-flight async save (PR-19 follow-up (a)) -------
+
+
+def test_restore_mid_async_save_flushes_live_checkpointer(tmp_path):
+    """`load_latest_valid_any` called while an async save is mid-write
+    must flush the live writer FIRST: it returns the newly committed
+    save (never a torn read of it) and cannot deadlock against it."""
+    path = str(tmp_path / "ncnet_tpu.msgpack")
+    sdir = sharded_dir_for(path)
+
+    def ckpt(step, fill):
+        return CheckpointData(
+            config=CFG,
+            params={"w": np.full((16,), fill, np.float32)},
+            step=step,
+        )
+
+    save_checkpoint_sharded(sdir, ckpt(1, 1.0))  # committed baseline
+
+    entered = threading.Event()
+
+    def slow_write(data):
+        entered.set()
+        time.sleep(1.0)  # the restore overlaps THIS window
+        save_checkpoint_sharded(sdir, data)
+
+    ack = AsyncCheckpointer(async_mode=True, registry=MetricsRegistry())
+    ack.submit(ckpt(2, 2.0), slow_write, step=2)
+    assert entered.wait(WAIT)  # the save is in flight right now
+
+    ck, used = load_latest_valid_any(path)  # must flush, then read
+    assert int(ck.step) == 2, "restore raced the in-flight save"
+    assert used == os.path.join(sdir, distributed.step_dir_name(2))
+    np.testing.assert_array_equal(
+        np.asarray(ck.params["w"]), np.full((16,), 2.0, np.float32)
+    )
+
+    ack.close()
+    # closed checkpointers leave the live registry: nothing to flush
+    assert flush_live_checkpointers(timeout=1.0) is True
+
+
+# --- subprocess drill helpers ------------------------------------------------
+
+_DRAIN_RE = re.compile(r"coordinated drain: all hosts stop at step (\d+)")
+_RESULT_RE = re.compile(r"^DRILL_RESULT (\{.*\})$", re.M)
+
+
+def _drill_result(out):
+    m = _RESULT_RE.search(out)
+    assert m, f"no DRILL_RESULT line in child output:\n{out}"
+    return json.loads(m.group(1))
+
+
+def _assert_bitwise_equal(ck_a, ck_b):
+    flat_a, _ = jax.tree_util.tree_flatten_with_path(ck_a.params)
+    flat_b, _ = jax.tree_util.tree_flatten_with_path(ck_b.params)
+    assert len(flat_a) == len(flat_b)
+    for (path_a, leaf_a), (_, leaf_b) in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(
+            np.asarray(leaf_a), np.asarray(leaf_b),
+            err_msg=f"params differ at {jax.tree_util.keystr(path_a)}",
+        )
+    for a, b in zip(
+        jax.tree.leaves(ck_a.opt_state), jax.tree.leaves(ck_b.opt_state)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(ck_a.step) == int(ck_b.step)
+    np.testing.assert_array_equal(
+        np.asarray(ck_a.train_loss), np.asarray(ck_b.train_loss)
+    )
+
+
+# --- drill: kill one host mid-epoch -> typed PeerDown -> elastic resume ------
+
+
+@needs_mp
+def test_kill_one_host_elastic_restart_bitwise(tmp_path):
+    """The acceptance drill: process 1's trainer is hard-killed at step
+    boundary 3 (`os._exit`, a true preemption). The survivor must raise
+    a TYPED PeerDown (no hang), exit EXIT_PEER_DOWN, re-form as a
+    1-process cluster, resume from the latest valid 2-process save
+    through the topology-changing restore, and finish — BITWISE equal
+    to an uninterrupted run of the same schedule (the 2-process phase
+    computes replicated: same batches, same math).
+
+    The uninterrupted reference runs in its OWN spawned child rather
+    than reusing the session fixture: XLA CPU emits (measurably, ~1e-6)
+    different float accumulation under the parent's different
+    host-device-count flags, and this drill pins RESUME correctness,
+    not cross-environment compilation determinism. The spawned-child
+    comparison is exact: 2-proc replicated == 1-proc, bitwise."""
+    results = spawn_cpu_cluster(
+        os.path.abspath(__file__),
+        n_procs=2,
+        local_devices=1,
+        timeout=540,
+        args=("elastic", str(tmp_path)),
+        per_proc_env={1: {"NCNET_FAULTS": "step.boundary=kill@3"}},
+    )
+    (rc0, out0), (rc1, out1) = results
+
+    # the killed side: a kill stays a kill, all the way up the tree
+    assert "hard kill at 'step.boundary'" in out1, out1
+    assert rc1 == 137, out1
+    assert "only a typed PeerDown restarts" in out1, out1
+
+    # the survivor: typed PeerDown within budget, re-form, resume, done
+    assert rc0 == 0, out0
+    assert "WORKER_PEERDOWN" in out0, out0
+    assert "peer 1 declared down" in out0, out0
+    assert "[elastic] re-formed gen 1: 1 survivor(s)" in out0, out0
+    assert "WORKER_DONE" in out0, out0
+
+    # the uninterrupted reference, same child environment
+    ref_dir = str(tmp_path / "reference")
+    os.makedirs(ref_dir)
+    ((rc_ref, out_ref),) = spawn_cpu_cluster(
+        os.path.abspath(__file__),
+        n_procs=1,
+        local_devices=1,
+        timeout=300,
+        args=("solo", ref_dir),
+    )
+    assert rc_ref == 0, out_ref
+
+    ck_a, _ = load_latest_valid_any(os.path.join(ref_dir, "ncnet_tpu.msgpack"))
+    ck_b, _ = load_latest_valid_any(
+        os.path.join(str(tmp_path), "ncnet_tpu.msgpack")
+    )
+    _assert_bitwise_equal(ck_a, ck_b)
+    # the resumed run's epoch metrics also line up (proc-0-written)
+    def lines(d):
+        return [json.loads(l) for l in open(os.path.join(d, "metrics.jsonl"))]
+
+    strip = lambda l: {k: v for k, v in l.items() if k != "epoch_seconds"}
+    assert [strip(l) for l in lines(str(tmp_path))] == [
+        strip(l) for l in lines(ref_dir)
+    ]
+
+
+# --- drill: stop flag drains BOTH hosts to the identical committed step ------
+
+
+@needs_mp
+def test_stop_flag_drains_both_hosts_to_same_step(tmp_path):
+    """Coordinated preemption + regained coalescing, end to end: a
+    programmatic preemption on host 0 (the SIGTERM stand-in — same
+    guard path) publishes the stop flag; BOTH hosts drain to one agreed
+    step and commit it; and because this is an async+consensus run with
+    deliberately slow writes, every host also coalesced at least one
+    overlapped save collectively (``ckpt_coalesced_total > 0``)."""
+    results = spawn_cpu_cluster(
+        os.path.abspath(__file__),
+        n_procs=2,
+        local_devices=1,
+        timeout=420,
+        args=("stopflag", str(tmp_path)),
+        extra_env={"NCNET_FAULTS": "ackpt.write=delay:0.8"},
+    )
+    drains, reported = [], []
+    for code, out in results:
+        assert code == 0, f"stopflag child failed:\n{out}"
+        m = _DRAIN_RE.search(out)
+        assert m, f"no coordinated-drain line:\n{out}"
+        drains.append(int(m.group(1)))
+        reported.append(_drill_result(out))
+
+    assert drains[0] == drains[1], drains
+    for rep in reported:
+        assert rep["preempted"] is True
+        assert rep["coalesced"] > 0, rep  # consensus coalescing engaged
+        assert rep["rounds"] > 0, rep
+
+    # the shared directory's newest COMMITTED save is the drained step,
+    # and nothing past it exists (identical save sets by construction:
+    # a divergent sequence would have wedged the commit barrier)
+    sdir = sharded_dir_for(os.path.join(str(tmp_path), "ncnet_tpu.msgpack"))
+    committed = sorted(
+        int(distributed.STEP_DIR_RE.match(name).group(1))
+        for name in os.listdir(sdir)
+        if distributed.STEP_DIR_RE.match(name)
+        and distributed.is_committed(os.path.join(sdir, name))
+    )
+    assert committed and committed[-1] == drains[0], (committed, drains)
+    ck, _ = load_latest_valid_any(os.path.join(str(tmp_path), "ncnet_tpu.msgpack"))
+    assert int(ck.step) == drains[0]
+
+
+# --- drills: consensus-round kills at cluster.propose / cluster.ack ----------
+
+
+@needs_mp
+@pytest.mark.parametrize(
+    "point,dead,survivor",
+    [("cluster.propose", 1, 0), ("cluster.ack", 0, 1)],
+    ids=["propose", "ack"],
+)
+def test_consensus_round_kill_leaves_survivor_typed(
+    tmp_path, point, dead, survivor
+):
+    """Kill a host inside the consensus round (before its proposal /
+    before the leader's decision): the peer waiting on the round must
+    get a typed PeerDown within the staleness budget — never the 120s
+    consensus timeout, never a hang. Pure protocol drill: no jax, no
+    compile — the rendezvous is plain files."""
+    results = spawn_cpu_cluster(
+        os.path.abspath(__file__),
+        n_procs=2,
+        local_devices=1,
+        timeout=90,
+        args=("conskill", str(tmp_path)),
+        per_proc_env={dead: {"NCNET_FAULTS": f"{point}=kill@3"}},
+    )
+    rc_dead, out_dead = results[dead]
+    rc_live, out_live = results[survivor]
+    assert rc_dead == 137, out_dead
+    assert f"hard kill at '{point}'" in out_dead, out_dead
+    assert rc_live == EXIT_PEER_DOWN, out_live
+    assert "WORKER_PEERDOWN" in out_live, out_live
+    assert f"peer {dead} declared down" in out_live, out_live
+    rep = _drill_result(out_live)
+    assert rep["rounds_done"] >= 2  # rounds worked until the kill
+    assert rep["wall_s"] < 30.0, rep  # staleness budget, not a timeout
+
+
+# --- drill: non-cluster multi-process SIGTERM (the documented degradation) ---
+
+
+@needs_mp
+def test_noncluster_sigterm_commits_on_signalled_host(tmp_path):
+    """Satellite: WITHOUT a cluster supervisor, a SIGTERM on one host of
+    a multi-process sharded run still exits that host cleanly with a
+    committed, walk-back-valid save (its final save coincides with the
+    every-step collective schedule). The un-signalled peer's next
+    barrier then fails TYPED (ShardedSaveError) — the documented
+    degradation that cluster mode's coordinated drain removes."""
+    results = spawn_cpu_cluster(
+        os.path.abspath(__file__),
+        n_procs=2,
+        local_devices=1,
+        timeout=420,
+        args=("sigterm", str(tmp_path)),
+    )
+    (rc0, out0), (rc1, out1) = results
+
+    assert rc0 == 0, out0  # the signalled host: clean exit
+    rep = _drill_result(out0)
+    assert rep["preempted"] is True
+    assert rep["step"] == 2  # signalled at boundary 2 -> committed there
+
+    assert rc1 == 3, out1  # the peer: typed failure, bounded
+    assert "SIGTERM_TYPED ShardedSaveError" in out1, out1
+
+    # the shared directory is walk-back-valid at the signalled step:
+    # the peer's torn post-exit save never commits and is skipped
+    ck, used = load_latest_valid_any(
+        os.path.join(str(tmp_path), "ncnet_tpu.msgpack")
+    )
+    assert int(ck.step) == 2
+    assert used.endswith(distributed.step_dir_name(2))
+
+
+# --- child mains (run via spawn_cpu_cluster / the elastic supervisor) --------
+
+
+def _pinned_train(workdir, cluster, **overrides):
+    """The conftest `uninterrupted_run` schedule (pinned seeds/geometry,
+    sharded saves), with resume-from-latest built in — the drills'
+    bitwise comparisons against the fixture depend on this matching."""
+    import jax
+
+    from ncnet_tpu.data.loader import DataLoader
+    from ncnet_tpu.data.pairs import SyntheticPairDataset
+    from ncnet_tpu.models.immatchnet import ImMatchNetConfig, init_immatchnet
+    from ncnet_tpu.resilience import distributed as dist
+    from ncnet_tpu.train.checkpoint import (
+        load_latest_valid_any,
+        sharded_dir_for,
+    )
+    from ncnet_tpu.train.loop import train
+
+    cfg = ImMatchNetConfig(ncons_kernel_sizes=(3,), ncons_channels=(1,))
+    ds = SyntheticPairDataset(n=8, output_size=(32, 32), seed=11)
+    loader = DataLoader(
+        ds, 2, shuffle=True, seed=5, drop_last=True,
+        num_workers=1, prefetch=0,
+    )
+    kw = dict(
+        num_epochs=2, checkpoint_dir=workdir, data_parallel=False,
+        log_every=100, save_every_steps=2, keep_checkpoints=4,
+        distributed_checkpoints=True, cluster=cluster,
+    )
+    path = os.path.join(workdir, "ncnet_tpu.msgpack")
+    sdir = sharded_dir_for(path)
+    committed = os.path.isdir(sdir) and any(
+        dist.is_committed(os.path.join(sdir, n))
+        for n in os.listdir(sdir)
+        if dist.STEP_DIR_RE.match(n)
+    )
+    params = None
+    if committed:
+        ck, used = load_latest_valid_any(path)
+        print(f"CHILD_RESUME from {used}", flush=True)
+        params = ck.params
+        kw.update(
+            opt_state=ck.opt_state, start_epoch=ck.epoch, start_step=ck.step,
+            initial_best_val=ck.best_val_loss,
+            initial_train_hist=ck.train_loss, initial_val_hist=ck.val_loss,
+        )
+        if ck.cursor:
+            kw.update(
+                start_epoch=ck.cursor["epoch"],
+                start_batch=ck.cursor["batch_index"],
+                start_epoch_losses=ck.cursor["epoch_losses"],
+            )
+    if params is None:
+        params = init_immatchnet(jax.random.PRNGKey(0), cfg)
+    kw.update(overrides)
+    return train(cfg, params, loader, None, **kw)
+
+
+def _boundary_trigger(hit, action):
+    """Patch `faultinject.fire` so step boundary number ``hit`` runs
+    ``action`` on the step thread — the deterministic stand-in for an
+    async signal landing mid-epoch (test_resilience's counting idiom)."""
+    real_fire = faultinject.fire
+    state = {"n": 0}
+
+    def fire(point, data=None):
+        out = real_fire(point, data)
+        if point == "step.boundary":
+            state["n"] += 1
+            if state["n"] == hit:
+                action()
+        return out
+
+    faultinject.fire = fire
+
+
+def _elastic_main(workdir):
+    """spawn_cpu_cluster child for the elastic drill: the per-host
+    supervisor process (no jax here — only its trainer children pay
+    that). Initial topology comes from the harness env; re-formation
+    re-ranks the survivors."""
+    pid = int(os.environ["_NCNET_MH_PID"])
+    coord = os.environ["_NCNET_MH_COORD"]
+
+    def build_argv(topo):
+        return [sys.executable, os.path.abspath(__file__), "worker", workdir]
+
+    sup = ElasticSupervisor(
+        os.path.join(workdir, "cluster"), build_argv, pid, 2,
+        coordinator=coord, reform_window_s=2.0,
+    )
+    rc = sup.run()
+    print(f"ELASTIC_DONE rc={rc}", flush=True)
+    raise SystemExit(rc)
+
+
+def _worker_main(workdir):
+    """The elastic drill's trainer: joins the generation's topology from
+    the NCNET_ELASTIC_* env, supervises via the shared cluster dir, and
+    converts PeerDown into the typed elastic-restart exit status —
+    exactly what ``scripts/train.py --elastic`` does."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    gen = int(os.environ["NCNET_ELASTIC_GEN"])
+    pid = int(os.environ["NCNET_ELASTIC_PID"])
+    n = int(os.environ["NCNET_ELASTIC_NPROCS"])
+    coord = os.environ.get("NCNET_ELASTIC_COORD") or None
+
+    from ncnet_tpu.parallel.mesh import initialize_multihost
+
+    if n > 1:
+        initialize_multihost(
+            coordinator_address=coord, num_processes=n, process_id=pid
+        )
+
+    cluster = None
+    if n > 1:
+        cluster = ClusterSupervisor(
+            os.path.join(workdir, "cluster"), pid, n, generation=gen,
+            heartbeat_interval_s=0.2, staleness_s=2.0,
+        )
+        cluster.start()
+    try:
+        _pinned_train(workdir, cluster)
+        print("WORKER_DONE", flush=True)
+    except PeerDown as e:
+        print(f"WORKER_PEERDOWN {e}", flush=True)
+        if cluster is not None:
+            cluster.close()
+        # HARD exit (scripts/train.py posture): don't join the jax
+        # distributed runtime's atexit shutdown barrier with a dead
+        # peer — the coordination service SIGABRTs, clobbering the
+        # typed status the elastic supervisor keys restarts on
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(EXIT_PEER_DOWN)
+    finally:
+        if cluster is not None:
+            cluster.close()
+
+
+def _solo_main(workdir):
+    """The elastic drill's uninterrupted reference: the pinned schedule,
+    single process, no cluster — run in the SAME spawned environment as
+    the drill so the bitwise comparison sees identical XLA codegen."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    _pinned_train(workdir, None)
+    print("SOLO_DONE", flush=True)
+
+
+def _stopflag_main(workdir):
+    """Stop-flag drill child: async+consensus 2-process run; host 0
+    requests preemption at step boundary 3 (programmatic — the same
+    guard path a SIGTERM takes); both hosts drain to the agreed step."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    pid = int(os.environ["_NCNET_MH_PID"])
+    coord = os.environ["_NCNET_MH_COORD"]
+
+    from ncnet_tpu.parallel.mesh import initialize_multihost
+    from ncnet_tpu.resilience.signals import PreemptionGuard
+    from ncnet_tpu.telemetry.registry import default_registry
+
+    initialize_multihost(
+        coordinator_address=coord, num_processes=2, process_id=pid
+    )
+    cluster = ClusterSupervisor(
+        os.path.join(workdir, "cluster"), pid, 2,
+        heartbeat_interval_s=0.2, staleness_s=8.0, stop_poll_s=0.05,
+    )
+    cluster.start()
+    guard = PreemptionGuard(cluster=cluster)
+    if pid == 0:
+        _boundary_trigger(3, guard.request)
+    try:
+        _, history = _pinned_train(
+            workdir, cluster,
+            num_epochs=3, save_every_steps=1, async_checkpoints=True,
+            preemption=guard,
+        )
+    finally:
+        cluster.close()
+    reg = default_registry()
+    coalesced = reg.get("ckpt_coalesced_total")
+    rounds = reg.get("ckpt_consensus_rounds_total")
+    print(
+        "DRILL_RESULT "
+        + json.dumps({
+            "pid": pid,
+            "preempted": bool(history["preempted"]),
+            "coalesced": coalesced.value if coalesced else 0,
+            "rounds": rounds.value if rounds else 0,
+        }),
+        flush=True,
+    )
+
+
+def _conskill_main(workdir):
+    """Consensus-kill drill child: NO jax — two supervisors running
+    lockstep save-cursor rounds over the shared dir; the armed fault
+    kills one mid-round and the peer must fail typed, wall-bounded."""
+    pid = int(os.environ["_NCNET_MH_PID"])
+    sup = ClusterSupervisor(
+        os.path.join(workdir, "cluster"), pid, 2,
+        heartbeat_interval_s=0.1, staleness_s=1.5, poll_interval_s=0.02,
+    )
+    sup.start()
+    t0 = time.monotonic()
+    done = 0
+    try:
+        for step in range(1, 21):
+            sup.agree_save_cursor(step, busy=False)
+            done += 1
+            time.sleep(0.05)
+        print("CONSKILL_COMPLETED_ALL_ROUNDS", flush=True)
+    except PeerDown as e:
+        print(f"WORKER_PEERDOWN {e}", flush=True)
+        print(
+            "DRILL_RESULT "
+            + json.dumps({
+                "pid": pid,
+                "rounds_done": done,
+                "wall_s": time.monotonic() - t0,
+            }),
+            flush=True,
+        )
+        sys.exit(EXIT_PEER_DOWN)
+    finally:
+        sup.close()
+
+
+def _sigterm_main(workdir):
+    """Non-cluster SIGTERM drill child: 2-process sharded sync run with
+    a save at EVERY boundary; host 0 SIGTERMs itself at boundary 2. Its
+    final save coincides with the collective schedule, so it commits
+    and the host exits cleanly. Host 1's next barrier must fail typed
+    (bounded here by a small barrier_timeout) — the degradation cluster
+    mode exists to remove."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    pid = int(os.environ["_NCNET_MH_PID"])
+    coord = os.environ["_NCNET_MH_COORD"]
+
+    from ncnet_tpu.parallel.mesh import initialize_multihost
+    from ncnet_tpu.resilience.distributed import ShardedSaveError
+    from ncnet_tpu.resilience.signals import PreemptionGuard
+    import ncnet_tpu.train.loop as loop_mod
+    from ncnet_tpu.train.checkpoint import load_latest_valid_any
+
+    initialize_multihost(
+        coordinator_address=coord, num_processes=2, process_id=pid
+    )
+    # bound the abandoned peer's barrier so the drill is wall-capped
+    orig_save = loop_mod.save_checkpoint_sharded
+    loop_mod.save_checkpoint_sharded = lambda *a, **k: orig_save(
+        *a, **{**k, "barrier_timeout": 15.0}
+    )
+    guard = PreemptionGuard()
+    if pid == 0:
+        _boundary_trigger(
+            2, lambda: os.kill(os.getpid(), signal.SIGTERM)
+        )
+    with guard:
+        try:
+            _, history = _pinned_train(
+                workdir, None, num_epochs=1, save_every_steps=1,
+                preemption=guard,
+            )
+        except ShardedSaveError as e:
+            print(f"SIGTERM_TYPED {type(e).__name__}: {e}", flush=True)
+            sys.exit(3)
+    ck, _ = load_latest_valid_any(os.path.join(workdir, "ncnet_tpu.msgpack"))
+    print(
+        "DRILL_RESULT "
+        + json.dumps({
+            "pid": pid,
+            "preempted": bool(history["preempted"]),
+            "step": int(ck.step),
+        }),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    # `python tests/test_cluster.py <role> <workdir>` — the child entry
+    # for every subprocess drill (repo root already on sys.path above)
+    _role = sys.argv[1]
+    _mains = {
+        "elastic": _elastic_main,
+        "worker": _worker_main,
+        "solo": _solo_main,
+        "stopflag": _stopflag_main,
+        "conskill": _conskill_main,
+        "sigterm": _sigterm_main,
+    }
+    _mains[_role](sys.argv[2])
